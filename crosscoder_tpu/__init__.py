@@ -1,0 +1,28 @@
+"""crosscoder_tpu — a TPU-native (JAX/XLA/Pallas/pjit) crosscoder model-diffing framework.
+
+This package provides, from scratch and TPU-first, everything the reference
+PyTorch repo `mitroitskii/crosscoder-model-diff-replication` offers:
+
+- crosscoder training on paired (or N-way / multi-layer) residual-stream
+  activations (reference: ``crosscoder.py``, ``trainer.py``),
+- on-device activation harvesting from a JAX Gemma-2 runtime with hook
+  capture/splicing (replacing TransformerLens; reference: ``buffer.py``),
+- decoder-norm / cosine-sim analysis and CE-recovered splicing evals
+  (reference: ``analysis.py`` and the demo notebook),
+- and the scale-out machinery the reference lacks: an explicit
+  ``jax.sharding.Mesh`` with data/model axes, XLA-collective-based
+  calibration and loss reductions, Pallas sparse-encode kernels, and full
+  train-state checkpointing with a converter for the reference's published
+  torch checkpoints.
+
+Import surface (lazy where heavyweight):
+
+    from crosscoder_tpu import CrossCoderConfig
+    from crosscoder_tpu.models import crosscoder
+"""
+
+from crosscoder_tpu.config import CrossCoderConfig, get_default_cfg
+
+__version__ = "0.1.0"
+
+__all__ = ["CrossCoderConfig", "get_default_cfg", "__version__"]

@@ -1,0 +1,210 @@
+"""The crosscoder: a sparse dictionary tied across N model/layer sources.
+
+Re-implements, TPU-first, the numeric contract of the reference
+``CrossCoder`` module (reference ``crosscoder.py:24-130``):
+
+- params ``W_enc [n, d_in, d_hidden]``, ``W_dec [d_hidden, n, d_in]``,
+  ``b_enc [d_hidden]``, ``b_dec [n, d_in]`` — same leaf names as the torch
+  ``state_dict`` so the checkpoint converter is trivial, but with the source
+  axis ``n`` generalized from the reference's hardcoded 2
+  (reference ``crosscoder.py:32``) to any ``n_models × n_hooked_layers``.
+- init: ``W_dec`` rows drawn N(0,1) then rescaled to ``dec_init_norm`` per
+  (latent, source) (reference ``crosscoder.py:36-53``); ``W_enc`` initialized
+  as the transpose of ``W_dec`` (reference ``crosscoder.py:54-58``); biases 0.
+- encode/decode as single einsums that XLA maps onto the MXU
+  (reference ``crosscoder.py:69-89``), with fp32 accumulation.
+- ``get_losses`` reproducing the reference's loss surface exactly
+  (reference ``crosscoder.py:96-130``): summed-square-error L2 (mean over
+  batch), explained variance overall and per source (eps 1e-8),
+  **decoder-norm-weighted** L1 (reference ``crosscoder.py:123-126``), and L0.
+
+Design notes (why this is not a torch translation):
+
+- Everything is a pure function over a params pytree — no module object, no
+  device state; ``jax.jit``/``pjit`` owns placement. Sharding is expressed
+  separately (mesh + NamedSharding rules in the parallel layer) and
+  propagates through these einsums, so the same code is the single-chip and
+  the multi-chip kernel.
+- Compute dtype (``enc_dtype``, usually bf16 for the MXU) is separated from
+  loss dtype (always fp32, matching the reference's upcast at
+  ``crosscoder.py:104``).
+- Sparse activations (TopK / JumpReLU / BatchTopK) are first-class via
+  :mod:`crosscoder_tpu.ops.activations`, with a Pallas kernel path for the
+  TopK inner loop; the reference has only dense ReLU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.ops import activations as act_ops
+from crosscoder_tpu.utils.dtypes import dtype_of
+
+Params = dict[str, jax.Array]
+
+
+class LossOutput(NamedTuple):
+    """Loss surface of one batch (shapes as the reference returns them,
+    reference ``crosscoder.py:15-22``); all fp32."""
+
+    l2_loss: jax.Array                    # scalar: mean over batch of summed sq err
+    l1_loss: jax.Array                    # scalar: decoder-norm-weighted L1
+    l0_loss: jax.Array                    # scalar: mean active latents
+    explained_variance: jax.Array         # [batch]
+    explained_variance_per_source: jax.Array  # [n_sources, batch] (ref: _A/_B pair)
+
+
+def init_params(key: jax.Array, cfg: CrossCoderConfig) -> Params:
+    """Initialize crosscoder params.
+
+    Matches the reference init semantics (reference ``crosscoder.py:33-62``):
+    decoder rows are standard-normal rescaled so each (latent, source) row has
+    norm ``dec_init_norm``; the encoder starts as the decoder transpose; biases
+    start at zero. (The reference draws W_dec twice and keeps the second draw,
+    ``crosscoder.py:36-49`` — RNG noise we deliberately do not replicate.)
+    """
+    n, d_in, d_hidden = cfg.n_sources, cfg.d_in, cfg.dict_size
+    dtype = dtype_of(cfg.enc_dtype)
+    w = jax.random.normal(key, (d_hidden, n, d_in), dtype=jnp.float32)
+    w = w / jnp.linalg.norm(w, axis=-1, keepdims=True) * cfg.dec_init_norm
+    params: Params = {
+        "W_dec": w.astype(dtype),
+        "W_enc": jnp.transpose(w, (1, 2, 0)).astype(dtype),
+        "b_enc": jnp.zeros((d_hidden,), dtype=dtype),
+        "b_dec": jnp.zeros((n, d_in), dtype=dtype),
+    }
+    if cfg.activation == "jumprelu":
+        # log-threshold parameterization keeps theta positive under Adam
+        params["log_theta"] = jnp.full((d_hidden,), jnp.log(cfg.jumprelu_theta), dtype=jnp.float32)
+    return params
+
+
+def pre_acts(params: Params, x: jax.Array) -> jax.Array:
+    """Encoder pre-activations: ``x @ W_enc + b_enc`` summed over sources.
+
+    x: ``[..., n_sources, d_in]`` → ``[..., d_hidden]``. One einsum, contracted
+    over both the source and feature axes (reference ``crosscoder.py:71-75``),
+    with fp32 MXU accumulation.
+    """
+    h = jnp.einsum(
+        "...nd,ndh->...h", x, params["W_enc"], preferred_element_type=jnp.float32
+    )
+    return (h + params["b_enc"].astype(jnp.float32)).astype(x.dtype)
+
+
+def encode(params: Params, x: jax.Array, cfg: CrossCoderConfig, *, apply_activation: bool = True) -> jax.Array:
+    """Latent activations ``[..., d_hidden]``.
+
+    ``apply_activation=False`` returns raw pre-activations (the reference's
+    ``apply_relu=False`` path, ``crosscoder.py:69-80``).
+    """
+    h = pre_acts(params, x)
+    if not apply_activation:
+        return h
+    return act_ops.apply(h, cfg, params)
+
+
+def decode(params: Params, f: jax.Array) -> jax.Array:
+    """Reconstruction ``[..., n_sources, d_in]`` from latents ``[..., d_hidden]``
+    (reference ``crosscoder.py:82-89``)."""
+    y = jnp.einsum(
+        "...h,hnd->...nd", f, params["W_dec"], preferred_element_type=jnp.float32
+    )
+    return (y + params["b_dec"].astype(jnp.float32)).astype(f.dtype)
+
+
+def forward(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> jax.Array:
+    """encode → decode (reference ``crosscoder.py:91-94``)."""
+    return decode(params, encode(params, x, cfg))
+
+
+def get_losses(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> LossOutput:
+    """Full loss surface for a batch ``x: [batch, n_sources, d_in]``.
+
+    Numerics follow reference ``crosscoder.py:96-130`` exactly, with the
+    fp32 upcast for all loss reductions (reference ``crosscoder.py:104``):
+
+    - ``l2``: per-row sum of squared error over (source, d_in), mean over batch
+    - explained variance: ``1 − l2_row / (total_variance_row + 1e-8)``, where
+      total variance is about the batch mean
+    - ``l1``: ``mean_b Σ_f acts[b,f] · Σ_n ‖W_dec[f,n]‖`` — the decoder-norm
+      weighted form (reference ``crosscoder.py:123-126``), NOT plain Σ|acts|
+    - ``l0``: mean count of strictly-positive latents
+    """
+    x = x.astype(dtype_of(cfg.enc_dtype))
+    f = encode(params, x, cfg)
+    recon = decode(params, f)
+
+    xf = x.astype(jnp.float32)
+    rf = recon.astype(jnp.float32)
+    err2 = jnp.square(rf - xf)                            # [B, n, d]
+    l2_per_row = jnp.sum(err2, axis=(-2, -1))             # [B]
+    l2_loss = jnp.mean(l2_per_row)
+
+    eps = 1e-8
+    centered = xf - jnp.mean(xf, axis=0, keepdims=True)
+    tot_var = jnp.sum(jnp.square(centered), axis=(-2, -1))  # [B]
+    explained_variance = 1.0 - l2_per_row / (tot_var + eps)
+
+    # per-source EV (reference computes _A and _B separately,
+    # crosscoder.py:115-121); vectorized over the source axis here
+    l2_per_source = jnp.sum(err2, axis=-1)                # [B, n]
+    var_per_source = jnp.sum(jnp.square(centered), axis=-1)  # [B, n]
+    ev_per_source = 1.0 - l2_per_source / (var_per_source + eps)  # [B, n]
+
+    ff = f.astype(jnp.float32)
+    dec_norms = jnp.linalg.norm(params["W_dec"].astype(jnp.float32), axis=-1)  # [H, n]
+    total_dec_norm = jnp.sum(dec_norms, axis=-1)          # [H]
+    l1_loss = jnp.mean(jnp.sum(ff * total_dec_norm[None, :], axis=-1))
+
+    l0_loss = jnp.mean(jnp.sum((ff > 0).astype(jnp.float32), axis=-1))
+
+    return LossOutput(
+        l2_loss=l2_loss,
+        l1_loss=l1_loss,
+        l0_loss=l0_loss,
+        explained_variance=explained_variance,
+        explained_variance_per_source=jnp.transpose(ev_per_source),
+    )
+
+
+def training_loss(
+    params: Params, x: jax.Array, l1_coeff: jax.Array | float, cfg: CrossCoderConfig
+) -> tuple[jax.Array, LossOutput]:
+    """Scalar training objective ``l2 + l1_coeff · l1`` (reference
+    ``trainer.py:44``) plus the full loss surface as aux."""
+    losses = get_losses(params, x, cfg)
+    # TopK-style runs control sparsity structurally and typically set
+    # l1_coeff=0 in config; the objective shape is the same either way.
+    loss = losses.l2_loss + l1_coeff * losses.l1_loss
+    return loss, losses
+
+
+def param_count(cfg: CrossCoderConfig) -> int:
+    n, d, h = cfg.n_sources, cfg.d_in, cfg.dict_size
+    count = 2 * n * d * h + h + n * d
+    if cfg.activation == "jumprelu":
+        count += h  # log_theta
+    return count
+
+
+def fold_scaling_factors(params: Params, factors: Any) -> Params:
+    """Fold per-source activation-normalization factors into the weights.
+
+    Mirrors the notebook's ``fold_activation_scaling_factor`` (reference
+    ``nb:cell 27``): with per-source scale s (activations were trained on
+    ``x·s``), an equivalent crosscoder over *raw* activations has
+    ``W_enc[n] ·= s[n]``, ``W_dec[:, n] /= s[n]``, ``b_dec[n] /= s[n]``
+    (``b_enc`` unchanged). After folding, analysis/evals can run on
+    unnormalized model activations.
+    """
+    s = jnp.asarray(factors, dtype=jnp.float32)
+    out = dict(params)
+    out["W_enc"] = (params["W_enc"].astype(jnp.float32) * s[:, None, None]).astype(params["W_enc"].dtype)
+    out["W_dec"] = (params["W_dec"].astype(jnp.float32) / s[None, :, None]).astype(params["W_dec"].dtype)
+    out["b_dec"] = (params["b_dec"].astype(jnp.float32) / s[:, None]).astype(params["b_dec"].dtype)
+    return out

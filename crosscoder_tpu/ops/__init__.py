@@ -1,0 +1,2 @@
+"""Numeric ops: activation nonlinearities (dense + Pallas sparse kernels)
+and collective helpers."""

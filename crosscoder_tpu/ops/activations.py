@@ -1,0 +1,132 @@
+"""Encoder nonlinearities: dense ReLU (reference parity) plus the sparse
+activations the reference lacks (TopK / BatchTopK / JumpReLU).
+
+The reference supports only dense ReLU (reference ``crosscoder.py:76-77``).
+The TPU build adds structural-sparsity activations as first-class options
+(BASELINE.json config 2 calls for TopK(k=32) at dict_size 2^15), with:
+
+- ``topk``: per-row TopK of the ReLU'd pre-activations. Gradients flow only
+  through the surviving entries (the mask is a constant wrt the backward
+  pass, which is the standard straight-through treatment).
+- ``batchtopk``: TopK over the whole batch (k·batch entries globally), which
+  equalizes feature usage across rows.
+- ``jumprelu``: ``h · 1[h > θ]`` with the rectangle-kernel straight-through
+  estimator for θ gradients (Rajamanoharan et al., 2024 parameterization with
+  ``θ = exp(log_theta)``).
+
+A Pallas TPU kernel for the TopK inner loop lives in
+:mod:`crosscoder_tpu.ops.topk_pallas`; it is used automatically on TPU when
+shapes are tile-aligned, with these dense versions as the fallback/oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+
+if TYPE_CHECKING:
+    from crosscoder_tpu.config import CrossCoderConfig
+
+
+def relu(h: jax.Array) -> jax.Array:
+    return jnp.maximum(h, 0)
+
+
+def topk(h: jax.Array, k: int, *, use_pallas: bool | None = None) -> jax.Array:
+    """Keep the k largest ReLU'd entries per row, zero elsewhere.
+
+    ``h: [..., d_hidden]``. Ties broken by index (jax.lax.top_k semantics).
+    """
+    if use_pallas is None:
+        use_pallas = _default_use_pallas()
+    if use_pallas:
+        from crosscoder_tpu.ops import topk_pallas
+
+        if topk_pallas.supported(h, k):
+            return topk_pallas.topk(h, k)
+    return _topk_dense(h, k)
+
+
+def _topk_dense(h: jax.Array, k: int) -> jax.Array:
+    hp = relu(h)
+    # Exact-k scatter of the top-k entries (a >=threshold mask would keep
+    # extra entries on ties, which bf16 pre-acts make common).
+    vals, idx = jax.lax.top_k(hp, k)                    # [..., k] sorted desc
+    lead = hp.shape[:-1]
+    flat_vals = vals.reshape(-1, k)
+    flat_idx = idx.reshape(-1, k)
+    rows = jnp.arange(flat_idx.shape[0])[:, None]
+    out = jnp.zeros((flat_idx.shape[0], hp.shape[-1]), dtype=hp.dtype)
+    out = out.at[rows, flat_idx].set(flat_vals, mode="drop", unique_indices=True)
+    return out.reshape(*lead, hp.shape[-1])
+
+
+def batchtopk(h: jax.Array, k: int) -> jax.Array:
+    """TopK over the flattened (batch × d_hidden) pre-acts, keeping
+    ``k · batch`` entries globally; at eval time this behaves like a global
+    threshold (BatchTopK, Bussmann et al. 2024)."""
+    hp = relu(h)
+    n_rows = 1
+    for s in hp.shape[:-1]:
+        n_rows *= s
+    flat = hp.reshape(-1)
+    kk = min(k * n_rows, flat.shape[0])
+    vals = jax.lax.top_k(flat, kk)[0]
+    thresh = vals[-1]
+    mask = (hp >= thresh) & (hp > 0)
+    return hp * jax.lax.stop_gradient(mask.astype(hp.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def jumprelu(h: jax.Array, log_theta: jax.Array, bandwidth: float) -> jax.Array:
+    theta = jnp.exp(log_theta).astype(h.dtype)
+    return h * (h > theta)
+
+
+def _jumprelu_fwd(h, log_theta, bandwidth):
+    theta = jnp.exp(log_theta).astype(h.dtype)
+    return h * (h > theta), (h, theta)
+
+
+def _jumprelu_bwd(bandwidth, res, g):
+    h, theta = res
+    hf = h.astype(jnp.float32)
+    tf = theta.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    # d out / d h: pass-through where the unit is on (the jump itself gets no
+    # gradient wrt h — standard JumpReLU STE choice)
+    dh = gf * (hf > tf)
+    # d out / d theta via rectangle kernel K(u)=1[|u|<=1/2] of width `bandwidth`:
+    # ∂/∂θ ≈ −(θ/ε)·K((h−θ)/ε); chain through θ = exp(log_theta).
+    rect = (jnp.abs(hf - tf) <= bandwidth / 2).astype(jnp.float32)
+    dtheta_units = -(tf / bandwidth) * rect * gf
+    dlog_theta = jnp.sum(
+        dtheta_units * tf, axis=tuple(range(dtheta_units.ndim - 1))
+    ).astype(jnp.float32)
+    return dh.astype(h.dtype), dlog_theta
+
+
+jumprelu.defvjp(_jumprelu_fwd, _jumprelu_bwd)
+
+
+def apply(h: jax.Array, cfg: "CrossCoderConfig", params: dict | None = None) -> jax.Array:
+    """Dispatch on ``cfg.activation``."""
+    if cfg.activation == "relu":
+        return relu(h)
+    if cfg.activation == "topk":
+        return topk(h, cfg.topk_k)
+    if cfg.activation == "batchtopk":
+        return batchtopk(h, cfg.topk_k)
+    if cfg.activation == "jumprelu":
+        if params is None or "log_theta" not in params:
+            raise ValueError("jumprelu requires params['log_theta']")
+        return jumprelu(h, params["log_theta"], cfg.jumprelu_bandwidth)
+    raise ValueError(f"unknown activation {cfg.activation!r}")
+
+
+@functools.lru_cache(maxsize=1)
+def _default_use_pallas() -> bool:
+    return jax.default_backend() == "tpu"

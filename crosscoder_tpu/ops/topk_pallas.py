@@ -1,0 +1,19 @@
+"""Pallas TPU kernel for the TopK sparse-encode inner loop.
+
+Placeholder gate for now: :func:`supported` returns False until the kernel
+lands, so :func:`crosscoder_tpu.ops.activations.topk` uses the dense
+``lax.top_k`` path everywhere. The kernel itself is built in a later stage
+(BASELINE.json config 2: TopK(k=32) at dict_size 2^15).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def supported(h: jax.Array, k: int) -> bool:
+    return False
+
+
+def topk(h: jax.Array, k: int) -> jax.Array:  # pragma: no cover - gated off
+    raise NotImplementedError("pallas topk kernel not yet enabled")

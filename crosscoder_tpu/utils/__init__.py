@@ -1,0 +1,1 @@
+"""Small shared utilities (dtypes, trees, logging, timing)."""

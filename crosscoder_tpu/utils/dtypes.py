@@ -1,0 +1,19 @@
+"""Dtype-name mapping, mirroring the reference's DTYPES table
+(reference ``crosscoder.py:12``, ``train.py:5``) in JAX terms."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+DTYPES = {
+    "fp32": jnp.float32,
+    "fp16": jnp.float16,
+    "bf16": jnp.bfloat16,
+}
+
+
+def dtype_of(name: str) -> jnp.dtype:
+    try:
+        return DTYPES[name]
+    except KeyError:
+        raise ValueError(f"unknown dtype name {name!r}; expected one of {list(DTYPES)}") from None

@@ -1,0 +1,23 @@
+"""Test environment: force CPU with 8 virtual XLA devices so every sharding
+test runs an honest 8-way mesh without TPU hardware (SURVEY.md §4).
+
+Note: the environment may pre-set JAX_PLATFORMS (e.g. to a TPU plugin) and
+pre-import jax at interpreter startup, so we must both override the env var
+(for subprocesses) and update the live jax config (for this process).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+
+def pytest_report_header():
+    return f"jax backend: {jax.default_backend()} devices: {jax.device_count()}"

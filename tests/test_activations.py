@@ -1,0 +1,72 @@
+"""Tests for the sparse activation family (TopK / BatchTopK / JumpReLU) —
+TPU-native additions with no reference counterpart (reference has dense ReLU
+only, crosscoder.py:76-77)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.ops import activations as act
+
+
+def test_topk_keeps_k_largest():
+    h = jnp.asarray(np.random.default_rng(0).normal(size=(16, 64)).astype(np.float32))
+    out = act.topk(h, 8, use_pallas=False)
+    n_active = np.asarray((out > 0).sum(axis=-1))
+    assert (n_active <= 8).all()
+    # surviving values are unchanged
+    hp = np.maximum(np.asarray(h), 0)
+    mask = np.asarray(out) > 0
+    np.testing.assert_allclose(np.asarray(out)[mask], hp[mask])
+    # each row's kept entries are its largest positives
+    for r in range(16):
+        kept = set(np.flatnonzero(mask[r]))
+        expect = set(np.argsort(-hp[r])[: len(kept)])
+        assert kept == expect
+
+
+def test_topk_gradient_flows_only_through_survivors():
+    h = jnp.asarray([[3.0, 1.0, 2.0, -1.0]])
+    g = jax.grad(lambda x: act.topk(x, 2, use_pallas=False).sum())(h)
+    np.testing.assert_allclose(np.asarray(g), [[1.0, 0.0, 1.0, 0.0]])
+
+
+def test_batchtopk_global_budget():
+    h = jnp.asarray(np.random.default_rng(1).normal(size=(8, 32)).astype(np.float32))
+    out = act.batchtopk(h, 4)
+    assert int((out > 0).sum()) <= 4 * 8
+
+
+def test_jumprelu_forward_and_theta_grad():
+    log_theta = jnp.log(jnp.asarray([0.5, 0.5, 0.5]))
+    h = jnp.asarray([[0.2, 0.6, 1.5]])
+    out = act.jumprelu(h, log_theta, 0.3)
+    np.testing.assert_allclose(np.asarray(out), [[0.0, 0.6, 1.5]])
+    # h-grad passes through active units only
+    gh = jax.grad(lambda x: act.jumprelu(x, log_theta, 0.3).sum())(h)
+    np.testing.assert_allclose(np.asarray(gh), [[0.0, 1.0, 1.0]])
+    # theta-grad is nonzero only near the threshold (|h−θ| ≤ bandwidth/2):
+    # h=0.6 with θ=0.5, bw=0.3 → inside window; others outside
+    gt = jax.grad(lambda lt: act.jumprelu(h, lt, 0.3).sum(), argnums=0)(log_theta)
+    assert float(gt[0]) == 0.0
+    assert float(gt[1]) != 0.0
+    assert float(gt[2]) == 0.0
+
+
+def test_jumprelu_via_config_dispatch():
+    cfg = CrossCoderConfig(d_in=8, dict_size=16, enc_dtype="fp32", activation="jumprelu")
+    p = cc.init_params(jax.random.key(0), cfg)
+    assert "log_theta" in p
+    x = jax.random.normal(jax.random.key(1), (4, 2, 8))
+    out = cc.get_losses(p, x, cfg)
+    assert np.isfinite(float(out.l2_loss))
+
+
+def test_topk_via_config_dispatch():
+    cfg = CrossCoderConfig(d_in=8, dict_size=16, enc_dtype="fp32", activation="topk", topk_k=4)
+    p = cc.init_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (4, 2, 8))
+    f = cc.encode(p, x, cfg)
+    assert int((f > 0).sum(axis=-1).max()) <= 4

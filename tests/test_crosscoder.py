@@ -1,0 +1,124 @@
+"""Parity tests of the JAX crosscoder core against the torch-CPU oracle
+(SURVEY.md §4 "recon-MSE+L1 parity gate") plus init-property checks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+
+from torch_oracle import oracle_decode, oracle_encode, oracle_losses
+
+B, N, D, H = 32, 2, 16, 64
+
+
+def small_cfg(**kw):
+    base = dict(d_in=D, dict_size=H, n_models=N, enc_dtype="fp32", batch_size=B)
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = small_cfg()
+    params = cc.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(B, N, D)).astype(np.float32)
+    tp = {k: torch.from_numpy(np.asarray(v)) for k, v in params.items()}
+    return cfg, params, x, tp
+
+
+def test_init_properties():
+    cfg = small_cfg(dec_init_norm=0.08)
+    p = cc.init_params(jax.random.key(0), cfg)
+    assert p["W_enc"].shape == (N, D, H)
+    assert p["W_dec"].shape == (H, N, D)
+    assert p["b_enc"].shape == (H,)
+    assert p["b_dec"].shape == (N, D)
+    # decoder rows have norm dec_init_norm per (latent, source) — reference crosscoder.py:51-53
+    norms = jnp.linalg.norm(p["W_dec"], axis=-1)
+    np.testing.assert_allclose(np.asarray(norms), 0.08, rtol=1e-5)
+    # encoder is the decoder transpose — reference crosscoder.py:54-58
+    np.testing.assert_allclose(
+        np.asarray(p["W_enc"]), np.asarray(jnp.transpose(p["W_dec"], (1, 2, 0))), rtol=0
+    )
+    assert float(jnp.abs(p["b_enc"]).max()) == 0.0
+    assert float(jnp.abs(p["b_dec"]).max()) == 0.0
+
+
+def test_encode_decode_parity(setup):
+    cfg, params, x, tp = setup
+    f = cc.encode(params, jnp.asarray(x), cfg)
+    f_t = oracle_encode(torch.from_numpy(x), tp["W_enc"], tp["b_enc"])
+    np.testing.assert_allclose(np.asarray(f), f_t.numpy(), rtol=1e-5, atol=1e-5)
+
+    y = cc.decode(params, f)
+    y_t = oracle_decode(f_t, tp["W_dec"], tp["b_dec"])
+    np.testing.assert_allclose(np.asarray(y), y_t.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_losses_parity(setup):
+    cfg, params, x, tp = setup
+    out = cc.get_losses(params, jnp.asarray(x), cfg)
+    ref = oracle_losses(torch.from_numpy(x), tp["W_enc"], tp["W_dec"], tp["b_enc"], tp["b_dec"])
+    np.testing.assert_allclose(float(out.l2_loss), float(ref["l2"]), rtol=1e-5)
+    np.testing.assert_allclose(float(out.l1_loss), float(ref["l1"]), rtol=1e-5)
+    np.testing.assert_allclose(float(out.l0_loss), float(ref["l0"]), rtol=0)
+    np.testing.assert_allclose(np.asarray(out.explained_variance), ref["ev"].numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out.explained_variance_per_source), ref["ev_per_source"].numpy(), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_training_loss_combines(setup):
+    cfg, params, x, _ = setup
+    loss, losses = cc.training_loss(params, jnp.asarray(x), 2.0, cfg)
+    np.testing.assert_allclose(float(loss), float(losses.l2_loss + 2.0 * losses.l1_loss), rtol=1e-6)
+
+
+def test_generalized_n_models():
+    # the reference hardcodes n_models=2 (crosscoder.py:32); we support any N
+    cfg = small_cfg(n_models=3)
+    p = cc.init_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (8, 3, D))
+    out = cc.get_losses(p, x, cfg)
+    assert out.explained_variance_per_source.shape == (3, 8)
+    y = cc.forward(p, x, cfg)
+    assert y.shape == (8, 3, D)
+
+
+def test_multi_layer_sources():
+    # multi-layer crosscoder: hooked layers stack onto the source axis
+    cfg = small_cfg(
+        n_models=2,
+        hook_points=("blocks.6.hook_resid_pre", "blocks.13.hook_resid_pre", "blocks.20.hook_resid_pre"),
+    )
+    assert cfg.n_sources == 6
+    p = cc.init_params(jax.random.key(0), cfg)
+    assert p["W_enc"].shape == (6, D, H)
+
+
+def test_fold_scaling_factors(setup):
+    cfg, params, x, _ = setup
+    s = np.array([0.5, 2.0], dtype=np.float32)
+    folded = cc.fold_scaling_factors(params, s)
+    # crosscoder trained on x*s must equal folded crosscoder on raw x (nb:cell 27)
+    xs = jnp.asarray(x) * jnp.asarray(s)[None, :, None]
+    y_norm = cc.forward(params, xs, cfg)            # reconstruction in normalized space
+    y_raw = cc.forward(folded, jnp.asarray(x), cfg)  # reconstruction in raw space
+    np.testing.assert_allclose(
+        np.asarray(y_norm) / s[None, :, None], np.asarray(y_raw), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_bf16_path_runs(setup):
+    cfg = small_cfg(enc_dtype="bf16")
+    p = cc.init_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(2), (B, N, D))
+    out = cc.get_losses(p, x, cfg)
+    # losses are fp32 regardless of compute dtype (reference crosscoder.py:104)
+    assert out.l2_loss.dtype == jnp.float32
+    assert np.isfinite(float(out.l2_loss))

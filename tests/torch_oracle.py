@@ -1,0 +1,71 @@
+"""Independent torch-CPU re-statement of the reference's crosscoder math,
+used as the golden oracle for parity tests (SURVEY.md §4: "port the math,
+feed identical synthetic inputs, assert JAX matches to dtype tolerance").
+
+Each function states the reference location it mirrors
+(``/root/reference/crosscoder.py`` / ``trainer.py``); written as free
+functions over explicit tensors, in fp32, so the oracle is unambiguous.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+def oracle_encode(x: torch.Tensor, w_enc: torch.Tensor, b_enc: torch.Tensor, relu: bool = True) -> torch.Tensor:
+    # reference crosscoder.py:69-80 — einsum over (models, d_model) then bias+ReLU
+    h = torch.einsum("bnd,ndh->bh", x, w_enc) + b_enc
+    return torch.relu(h) if relu else h
+
+
+def oracle_decode(f: torch.Tensor, w_dec: torch.Tensor, b_dec: torch.Tensor) -> torch.Tensor:
+    # reference crosscoder.py:82-89
+    return torch.einsum("bh,hnd->bnd", f, w_dec) + b_dec
+
+
+def oracle_losses(x: torch.Tensor, w_enc, w_dec, b_enc, b_dec) -> dict:
+    # reference crosscoder.py:96-130 (fp32 path)
+    f = oracle_encode(x, w_enc, b_enc)
+    recon = oracle_decode(f, w_dec, b_dec)
+    delta = (recon - x) ** 2
+    per_row = delta.sum(dim=(1, 2))
+    l2 = per_row.mean()
+
+    eps = 1e-8
+    ctr = x - x.mean(0)
+    tv = (ctr**2).sum(dim=(1, 2))
+    ev = 1 - per_row / (tv + eps)
+
+    n = x.shape[1]
+    ev_src = []
+    for i in range(n):
+        num = delta[:, i, :].sum(-1)
+        den = (ctr[:, i, :] ** 2).sum(-1)
+        ev_src.append(1 - num / (den + eps))
+
+    dec_norm_total = w_dec.norm(dim=-1).sum(dim=-1)  # [d_hidden]
+    l1 = (f * dec_norm_total[None, :]).sum(-1).mean(0)
+    l0 = (f > 0).float().sum(-1).mean()
+    return {
+        "l2": l2,
+        "l1": l1,
+        "l0": l0,
+        "ev": ev,
+        "ev_per_source": torch.stack(ev_src),
+        "acts": f,
+        "recon": recon,
+    }
+
+
+def oracle_lr_lambda(step: int, total_steps: int) -> float:
+    # reference trainer.py:28-32
+    if step < 0.8 * total_steps:
+        return 1.0
+    return 1.0 - (step - 0.8 * total_steps) / (0.2 * total_steps)
+
+
+def oracle_l1_coeff(step: int, total_steps: int, l1_coeff: float) -> float:
+    # reference trainer.py:34-39
+    if step < 0.05 * total_steps:
+        return l1_coeff * step / (0.05 * total_steps)
+    return l1_coeff
