@@ -1,0 +1,102 @@
+"""Benchmark: crosscoder training-step throughput on one TPU chip.
+
+Workload = BASELINE.json's headline config: Gemma-2-2B-shaped activations
+(d_in 2304, n_models 2), batch 4096 rows/step (reference train.py:15),
+dict_size 2^15, bf16 compute — the full train step (fwd, losses, bwd,
+global-norm clip, Adam, schedules) as one donated jitted function.
+
+Metric: activation rows consumed per second per chip.
+
+``vs_baseline``: the reference publishes no throughput numbers
+(BASELINE.md), so the denominator is an analytic single-A100 estimate for
+the same torch workload, documented here so it stays fixed across rounds:
+train step ≈ 3× forward FLOPs; forward ≈ 4·B·H·n·d FLOP ⇒ 1.81 GFLOP/row at
+dict 2^15; A100 bf16 peak 312 TFLOP/s at a generous 45% utilization for
+eager torch einsums ⇒ ~77k rows/s. vs_baseline = measured / 77_000.
+(North star: ≥8× via 8-chip DP at per-chip parity — BASELINE.json.)
+
+Prints exactly ONE JSON line.
+
+Env knobs (debug/CI only; defaults are the headline workload): BENCH_DICT,
+BENCH_BATCH, BENCH_STEPS, BENCH_CPU=1 (force the CPU backend).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_A100_ACTS_PER_SEC = 77_000.0
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    from crosscoder_tpu.config import CrossCoderConfig
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train.trainer import make_train_step
+
+    cfg = CrossCoderConfig(
+        d_in=2304,
+        dict_size=int(os.environ.get("BENCH_DICT", 2**15)),
+        n_models=2,
+        batch_size=int(os.environ.get("BENCH_BATCH", 4096)),
+        enc_dtype="bf16",
+        log_backend="null",
+    )
+    n_dev = len(jax.devices())
+    mesh = mesh_lib.make_mesh(data_axis_size=n_dev, model_axis_size=1)
+
+    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    state = init_train_state(jax.random.key(cfg.seed), cfg, tx)
+    shardings = mesh_lib.state_shardings(mesh, state)
+    state = jax.device_put(state, shardings)
+    step_fn = make_train_step(cfg, mesh, tx, shardings)
+
+    batch_sh = mesh_lib.batch_sharding(mesh)
+    key = jax.random.key(0)
+    batches = [
+        jax.device_put(
+            jax.random.normal(jax.random.fold_in(key, i), (cfg.batch_size, 2, cfg.d_in), dtype=jnp.bfloat16),
+            batch_sh,
+        )
+        for i in range(4)
+    ]
+
+    # warmup / compile
+    for i in range(3):
+        state, metrics = step_fn(state, batches[i % 4])
+    jax.block_until_ready(state.params["W_enc"])
+
+    n_steps = int(os.environ.get("BENCH_STEPS", 50))
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        state, metrics = step_fn(state, batches[i % 4])
+    jax.block_until_ready(state.params["W_enc"])
+    dt = time.perf_counter() - t0
+
+    acts_per_sec = cfg.batch_size * n_steps / dt
+    per_chip = acts_per_sec / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": f"crosscoder train acts/sec/chip (d_in {cfg.d_in}, dict {cfg.dict_size}, bf16)",
+                "value": round(per_chip, 1),
+                "unit": "activations/s/chip",
+                "vs_baseline": round(per_chip / BASELINE_A100_ACTS_PER_SEC, 3),
+                "n_devices": n_dev,
+                "step_ms": round(1000 * dt / n_steps, 2),
+                "loss_finite": bool(jnp.isfinite(metrics["loss"]).item()),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,7 @@ PyTorch repo `mitroitskii/crosscoder-model-diff-replication` offers:
 
 Import surface (lazy where heavyweight):
 
-    from crosscoder_tpu import CrossCoderConfig
+    from crosscoder_tpu import CrossCoderConfig, Trainer
     from crosscoder_tpu.models import crosscoder
 """
 
@@ -25,4 +25,14 @@ from crosscoder_tpu.config import CrossCoderConfig, get_default_cfg
 
 __version__ = "0.1.0"
 
-__all__ = ["CrossCoderConfig", "get_default_cfg", "__version__"]
+
+def __getattr__(name):
+    # lazy: importing Trainer pulls in optax/mesh machinery
+    if name == "Trainer":
+        from crosscoder_tpu.train.trainer import Trainer
+
+        return Trainer
+    raise AttributeError(name)
+
+
+__all__ = ["CrossCoderConfig", "Trainer", "get_default_cfg", "__version__"]

@@ -1,0 +1,3 @@
+"""Checkpointing: versioned layout, full train-state resume, torch compat."""
+
+from crosscoder_tpu.checkpoint.ckpt import Checkpointer  # noqa: F401

@@ -1,0 +1,85 @@
+"""Round-trip converter between our params pytree and the reference's torch
+checkpoint layout, so analysis stays compatible with its published artifacts.
+
+The reference state_dict (reference ``crosscoder.py:33-62``) has exactly the
+tensor names and axis orders we use natively:
+
+    W_enc [n_models, d_in, d_hidden]
+    W_dec [d_hidden, n_models, d_in]
+    b_enc [d_hidden]
+    b_dec [n_models, d_in]
+
+so conversion is a dtype/container change, not a transpose. The published
+HF artifact is ``{hook_point}/cc_weights.pt`` + ``cfg.json`` in repo
+``ckkissane/crosscoder-gemma-2-2b-model-diff`` (reference
+``crosscoder.py:160-205``); :func:`load_from_hf` mirrors that entry point,
+gated on hub availability (this build must also work air-gapped).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import TYPE_CHECKING
+
+import jax.numpy as jnp
+import numpy as np
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.utils.dtypes import dtype_of
+
+if TYPE_CHECKING:
+    from crosscoder_tpu.models.crosscoder import Params
+
+_PARAM_NAMES = ("W_enc", "W_dec", "b_enc", "b_dec")
+
+
+def params_from_torch_state_dict(state_dict: dict, cfg: CrossCoderConfig) -> "Params":
+    """Torch state_dict (reference layout) → JAX params pytree."""
+    params = {}
+    for name in _PARAM_NAMES:
+        t = state_dict[name]
+        arr = np.asarray(t.detach().to("cpu").float().numpy() if hasattr(t, "detach") else t)
+        params[name] = jnp.asarray(arr, dtype=dtype_of(cfg.enc_dtype))
+    return params
+
+
+def params_to_torch_state_dict(params: "Params", cfg: CrossCoderConfig) -> dict:
+    """JAX params → torch state_dict in the reference layout/dtype (so the
+    artifact drops into the reference's analysis stack unchanged)."""
+    import torch
+
+    torch_dtype = {"fp32": torch.float32, "fp16": torch.float16, "bf16": torch.bfloat16}[cfg.enc_dtype]
+    out = {}
+    for name in _PARAM_NAMES:
+        arr = np.asarray(params[name], dtype=np.float32)
+        out[name] = torch.from_numpy(arr).to(torch_dtype)
+    return out
+
+
+def save_torch_checkpoint(params: "Params", cfg: CrossCoderConfig, path: str | Path) -> None:
+    import torch
+
+    torch.save(params_to_torch_state_dict(params, cfg), path)
+
+
+def load_torch_checkpoint(path: str | Path, cfg: CrossCoderConfig) -> "Params":
+    import torch
+
+    return params_from_torch_state_dict(torch.load(path, map_location="cpu"), cfg)
+
+
+def load_from_hf(
+    repo_id: str = "ckkissane/crosscoder-gemma-2-2b-model-diff",
+    path: str = "blocks.14.hook_resid_pre",
+) -> tuple["Params", CrossCoderConfig]:
+    """Load the published reference checkpoint from the HF hub (reference
+    ``CrossCoder.load_from_hf``, crosscoder.py:160-205). Requires network;
+    raises a clear error when air-gapped."""
+    try:
+        from huggingface_hub import hf_hub_download
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("huggingface_hub is required for load_from_hf") from e
+    cfg_path = hf_hub_download(repo_id=repo_id, filename=f"{path}/cfg.json")
+    weights_path = hf_hub_download(repo_id=repo_id, filename=f"{path}/cc_weights.pt")
+    cfg = CrossCoderConfig.from_json(cfg_path)
+    return load_torch_checkpoint(weights_path, cfg), cfg

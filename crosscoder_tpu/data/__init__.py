@@ -1,0 +1,6 @@
+"""Data layer: activation sources feeding the trainer.
+
+Anything with ``next() -> [batch, n_sources, d_in]`` works: the paired
+Gemma-2 harvest buffer (the real path, reference ``buffer.py``), or the
+synthetic ground-truth-dictionary source (tests/benchmarks — the reference
+has no equivalent; its only data path needs two 2.6B-param models)."""

@@ -1,0 +1,59 @@
+"""Synthetic paired-activation source with a known sparse ground truth.
+
+Rows are generated as ``x = z @ D + ε`` where ``z`` is a sparse nonnegative
+code over ``n_true`` latent features and ``D`` is a fixed random dictionary
+over all sources — so a crosscoder trained on this source has a recoverable
+optimum and tests can assert that loss actually falls and EV rises
+(SURVEY.md §4 "End-to-end": the reference offers no model-free data path;
+this replaces 2×Gemma-2-2B in the loop for the training-skeleton slice).
+
+Deterministic per (seed, batch index): batch ``i`` is a pure function of the
+counter, so a resumed run sees the identical stream — the property the
+checkpoint tests rely on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from crosscoder_tpu.config import CrossCoderConfig
+
+
+class SyntheticActivationSource:
+    def __init__(
+        self,
+        cfg: CrossCoderConfig,
+        n_true: int | None = None,
+        sparsity: int = 8,
+        noise: float = 0.01,
+    ) -> None:
+        self.cfg = cfg
+        self.n_true = n_true if n_true is not None else max(16, cfg.dict_size // 4)
+        self.sparsity = sparsity
+        self.noise = noise
+        root = np.random.default_rng(cfg.seed)
+        d = root.normal(size=(self.n_true, cfg.n_sources, cfg.d_in)).astype(np.float32)
+        d /= np.linalg.norm(d, axis=-1, keepdims=True)
+        self.dictionary = d
+        self.counter = 0
+
+    def next(self) -> np.ndarray:
+        cfg = self.cfg
+        rng = np.random.default_rng((cfg.seed, self.counter))
+        self.counter += 1
+        b = cfg.batch_size
+        # sparse nonnegative codes: `sparsity` active features per row
+        idx = rng.integers(0, self.n_true, size=(b, self.sparsity))
+        mag = np.abs(rng.normal(1.0, 0.3, size=(b, self.sparsity))).astype(np.float32)
+        z = np.zeros((b, self.n_true), dtype=np.float32)
+        np.add.at(z, (np.arange(b)[:, None], idx), mag)
+        x = np.einsum("bt,tnd->bnd", z, self.dictionary)
+        x += rng.normal(0.0, self.noise, size=x.shape).astype(np.float32)
+        return x
+
+    # --- checkpointable pipeline state (step counter only) ---
+    def state_dict(self) -> dict:
+        return {"counter": self.counter}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.counter = int(d["counter"])

@@ -58,7 +58,7 @@ class LossOutput(NamedTuple):
     explained_variance_per_source: jax.Array  # [n_sources, batch] (ref: _A/_B pair)
 
 
-def init_params(key: jax.Array, cfg: CrossCoderConfig) -> Params:
+def init_params(key: jax.Array, cfg: CrossCoderConfig, dtype: jnp.dtype | None = None) -> Params:
     """Initialize crosscoder params.
 
     Matches the reference init semantics (reference ``crosscoder.py:33-62``):
@@ -66,9 +66,15 @@ def init_params(key: jax.Array, cfg: CrossCoderConfig) -> Params:
     norm ``dec_init_norm``; the encoder starts as the decoder transpose; biases
     start at zero. (The reference draws W_dec twice and keeps the second draw,
     ``crosscoder.py:36-49`` — RNG noise we deliberately do not replicate.)
+
+    ``dtype`` defaults to ``cfg.enc_dtype`` (the reference stores params in
+    the compute dtype, ``crosscoder.py:30-34``); the Trainer passes fp32 to
+    keep master weights + Adam moments in fp32 and casts to ``enc_dtype``
+    per-step inside the loss (mixed precision the TPU way, rather than the
+    reference's all-bf16 torch Adam).
     """
     n, d_in, d_hidden = cfg.n_sources, cfg.d_in, cfg.dict_size
-    dtype = dtype_of(cfg.enc_dtype)
+    dtype = dtype_of(cfg.enc_dtype) if dtype is None else dtype
     w = jax.random.normal(key, (d_hidden, n, d_in), dtype=jnp.float32)
     w = w / jnp.linalg.norm(w, axis=-1, keepdims=True) * cfg.dec_init_norm
     params: Params = {
@@ -172,12 +178,24 @@ def get_losses(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> LossOutpu
     )
 
 
+def cast_params(params: Params, dtype: jnp.dtype) -> Params:
+    """Cast weight leaves to the compute dtype (``log_theta`` stays fp32 —
+    its gradient path is the STE, not the MXU)."""
+    return {
+        k: (v if k == "log_theta" else v.astype(dtype)) for k, v in params.items()
+    }
+
+
 def training_loss(
     params: Params, x: jax.Array, l1_coeff: jax.Array | float, cfg: CrossCoderConfig
 ) -> tuple[jax.Array, LossOutput]:
     """Scalar training objective ``l2 + l1_coeff · l1`` (reference
-    ``trainer.py:44``) plus the full loss surface as aux."""
-    losses = get_losses(params, x, cfg)
+    ``trainer.py:44``) plus the full loss surface as aux.
+
+    Params may be fp32 masters; they are cast to ``cfg.enc_dtype`` here so
+    the einsums hit the MXU in bf16 while gradients accumulate into fp32.
+    """
+    losses = get_losses(cast_params(params, dtype_of(cfg.enc_dtype)), x, cfg)
     # TopK-style runs control sparsity structurally and typically set
     # l1_coeff=0 in config; the objective shape is the same either way.
     loss = losses.l2_loss + l1_coeff * losses.l1_loss

@@ -1,0 +1,117 @@
+"""Mesh construction and sharding rules for the crosscoder train step.
+
+Replaces the reference's absent parallelism (it is a single-process,
+single-GPU program — SURVEY.md §2 "parallelism statement") with the
+idiomatic JAX recipe: one explicit 2-axis ``Mesh``
+
+- ``data``: batch-axis data parallelism (DP) — activation rows are sharded,
+  gradients are psum-reduced by XLA under ``jit`` (component N2),
+- ``model``: tensor parallelism (TP) over the dictionary axis ``d_hidden``
+  of ``W_enc``/``W_dec``/``b_enc`` — L1/L0 latent reductions become XLA
+  psums over the shard axis (component N3).
+
+The crosscoder's source axis (``n_models``/layers) is small (2-6) and kept
+replicated; the per-source decoder norms and EVs are cheap. Scaling the
+source axis (component N4) rides the same `model` axis by sharding
+``d_hidden`` — each shard still sees every source, which the tied encoder
+einsum requires.
+
+Multi-host: ``jax.distributed.initialize`` + the same mesh over
+``jax.devices()`` spanning hosts; XLA routes ICI within a slice and DCN
+across slices. See :mod:`crosscoder_tpu.parallel.multihost`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# leaf-name → PartitionSpec for the crosscoder param pytree.
+# W_enc [n, d_in, H]: shard the dict axis; W_dec [H, n, d_in]: likewise.
+_PARAM_SPECS: dict[str, P] = {
+    "W_enc": P(None, None, "model"),
+    "W_dec": P("model", None, None),
+    "b_enc": P("model"),
+    "b_dec": P(None, None),
+    "log_theta": P("model"),
+}
+
+BATCH_SPEC = P("data", None, None)
+
+
+def make_mesh(
+    data_axis_size: int = -1,
+    model_axis_size: int = 1,
+    devices: list[Any] | None = None,
+) -> Mesh:
+    """Build the 2-axis ``('data', 'model')`` mesh.
+
+    ``data_axis_size=-1`` takes every device not claimed by the model axis.
+    On one device this degenerates to a 1×1 mesh and the whole train step
+    compiles exactly as the single-chip program.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if model_axis_size < 1 or n % model_axis_size:
+        raise ValueError(f"model_axis_size {model_axis_size} must divide device count {n}")
+    if data_axis_size == -1:
+        data_axis_size = n // model_axis_size
+    if data_axis_size * model_axis_size != n:
+        raise ValueError(
+            f"mesh {data_axis_size}x{model_axis_size} != {n} devices; "
+            "use data_axis_size=-1 to auto-fill"
+        )
+    arr = np.asarray(devices).reshape(data_axis_size, model_axis_size)
+    return Mesh(arr, ("data", "model"))
+
+
+def mesh_from_cfg(cfg) -> Mesh:
+    return make_mesh(cfg.data_axis_size, cfg.model_axis_size)
+
+
+def param_spec(name: str) -> P:
+    try:
+        return _PARAM_SPECS[name]
+    except KeyError:
+        raise ValueError(f"no sharding rule for param {name!r}") from None
+
+
+def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, param_spec(k)) for k in params}
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Activation batches ``[batch, n_sources, d_in]`` shard over ``data``."""
+    return NamedSharding(mesh, BATCH_SPEC)
+
+
+def state_shardings(mesh: Mesh, state: Any) -> Any:
+    """Shardings for a full TrainState pytree (params + optimizer state + step).
+
+    Optimizer moments mirror their parameter's sharding; anything that is not
+    under a recognized param name (e.g. Adam's ``count``, the step counter)
+    is replicated. Matching is by the dict key on the leaf's path, so any
+    optax state that nests the param tree (mu/nu) is covered without
+    special-casing optax internals.
+    """
+    replicated = NamedSharding(mesh, P())
+
+    def spec_of(path, leaf) -> NamedSharding:
+        for entry in reversed(path):
+            key = getattr(entry, "key", None)
+            if key in _PARAM_SPECS:
+                if hasattr(leaf, "ndim") and leaf.ndim == len(_PARAM_SPECS[key]):
+                    return NamedSharding(mesh, _PARAM_SPECS[key])
+                return replicated
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(spec_of, state)
+
+
+def shard_state(mesh: Mesh, state: Any) -> Any:
+    """Place a host-built TrainState onto the mesh per the rules above."""
+    return jax.device_put(state, state_shardings(mesh, state))

@@ -1,0 +1,3 @@
+"""Training layer: schedules, train state, the jitted sharded step, Trainer."""
+
+from crosscoder_tpu.train.trainer import Trainer  # noqa: F401

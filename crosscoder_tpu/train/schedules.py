@@ -1,0 +1,54 @@
+"""LR and L1-coefficient schedules.
+
+Numeric parity with the reference's two schedules, but as pure functions of
+the step counter that trace cleanly under ``jit`` (no Python branching on
+traced values):
+
+- LR (reference ``trainer.py:28-32``): constant, then linear decay to 0 over
+  the final ``lr_decay_frac`` (default last 20%) of training.
+- L1 coefficient (reference ``trainer.py:34-39``): linear warmup from 0 over
+  the first ``l1_warmup_frac`` (default 5%) of training, then constant.
+
+The reference evaluates both at the *pre-increment* step counter (λ(0)=1 on
+the first optimizer step; l1_coeff(0)=0), which these functions preserve.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from crosscoder_tpu.config import CrossCoderConfig
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def lr_schedule(cfg: CrossCoderConfig) -> Schedule:
+    total = cfg.total_steps
+    decay_start = (1.0 - cfg.lr_decay_frac) * total
+
+    def f(step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        frac = jnp.where(
+            step < decay_start,
+            1.0,
+            # clamp at 0 so training past total_steps never flips to ascent
+            jnp.maximum(0.0, 1.0 - (step - decay_start) / (total - decay_start)),
+        )
+        return cfg.lr * frac
+
+    return f
+
+
+def l1_coeff_schedule(cfg: CrossCoderConfig) -> Schedule:
+    total = cfg.total_steps
+    warmup = cfg.l1_warmup_frac * total
+
+    def f(step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        if warmup <= 0:
+            return jnp.full_like(step, cfg.l1_coeff)
+        return cfg.l1_coeff * jnp.minimum(1.0, step / warmup)
+
+    return f

@@ -1,0 +1,42 @@
+"""TrainState: the complete, checkpointable training state pytree.
+
+The reference checkpoints only model weights — optimizer state, step counter
+and data position are lost, so training cannot resume (SURVEY.md §5
+"Checkpoint / resume"). Here the state is one pytree carrying everything the
+sharded step updates; host-side data-pipeline state (token pointer, buffer
+RNG) is checkpointed alongside by :mod:`crosscoder_tpu.checkpoint`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+
+
+class TrainState(NamedTuple):
+    params: dict[str, jax.Array]
+    opt_state: Any
+    step: jax.Array  # int32 scalar
+
+
+def make_optimizer(cfg: CrossCoderConfig, lr_fn) -> optax.GradientTransformation:
+    """Grad-clip → Adam, matching the reference semantics:
+    ``clip_grad_norm_(max_norm=1.0)`` then ``torch.optim.Adam`` with
+    (beta1, beta2), eps 1e-8 (reference ``trainer.py:16-23,46``)."""
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2, eps=1e-8),
+        optax.scale_by_learning_rate(lr_fn),
+    )
+
+
+def init_train_state(key: jax.Array, cfg: CrossCoderConfig, tx: optax.GradientTransformation) -> TrainState:
+    # fp32 master weights; the loss casts to cfg.enc_dtype for MXU compute
+    params = cc.init_params(key, cfg, dtype=jnp.float32)
+    return TrainState(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))

@@ -1,0 +1,197 @@
+"""The Trainer: one jitted, donated, mesh-sharded train step + host loop.
+
+Reproduces the training semantics of the reference Trainer (reference
+``trainer.py:7-82``) with a TPU-native execution model:
+
+- The entire step body — encode/decode einsums, losses, backward, global-norm
+  clip, Adam, schedules — is ONE ``jax.jit``-compiled function over the
+  ``('data','model')`` mesh, with the TrainState donated (no host round-trip,
+  no per-step ``.item()`` syncs; the reference forces a device sync every
+  step at ``trainer.py:51-63``). Metrics stay on device and are only pulled
+  to host at ``log_every`` granularity (SURVEY.md §3.2 "TPU mapping").
+- Step math parity: ``loss = l2 + l1_coeff(step)·l1`` (``trainer.py:44``),
+  grad clip at global-norm 1.0 (``trainer.py:46``), Adam(β1, β2, eps 1e-8)
+  (``trainer.py:16-20``), LR/L1 schedules (``trainer.py:28-39``),
+  ``total_steps = num_tokens // batch_size`` (``trainer.py:14``).
+- Loop behavior parity: log every ``log_every`` steps, checkpoint every
+  ``save_every`` steps and once more in a ``finally:`` on any exit
+  (``trainer.py:72-82``) — plus real resume, which the reference lacks.
+
+The data source is any object with ``next() -> [batch, n_sources, d_in]``
+(the paired-activation Buffer in :mod:`crosscoder_tpu.data.buffer`, or the
+synthetic generator for tests/benchmarks), so the trainer is independent of
+how activations are harvested.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.train import schedules
+from crosscoder_tpu.train.state import TrainState, init_train_state, make_optimizer
+from crosscoder_tpu.utils.logging import MetricsLogger
+
+
+def make_train_step(
+    cfg: CrossCoderConfig, mesh, tx, state_shardings
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the compiled train step for a given mesh/optimizer."""
+    lr_fn = schedules.lr_schedule(cfg)
+    l1_fn = schedules.l1_coeff_schedule(cfg)
+    loss_fn = cc.training_loss
+    if cfg.remat:
+        loss_fn = jax.checkpoint(loss_fn, static_argnums=(3,))
+
+    def step_fn(state: TrainState, batch: jax.Array):
+        l1_coeff = l1_fn(state.step)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, losses), grads = grad_fn(state.params, batch, l1_coeff, cfg)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "l2_loss": losses.l2_loss,
+            "l1_loss": losses.l1_loss,
+            "l0_loss": losses.l0_loss,
+            "l1_coeff": l1_coeff,
+            "lr": lr_fn(state.step),
+            "explained_variance": jnp.mean(losses.explained_variance),
+        }
+        ev_src = jnp.mean(losses.explained_variance_per_source, axis=-1)  # [n_sources]
+        metrics["explained_variance_per_source"] = ev_src
+        new_state = TrainState(new_params, new_opt, state.step + 1)
+        return new_state, metrics
+
+    batch_sh = mesh_lib.batch_sharding(mesh)
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, batch_sh),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+
+
+def expand_metrics(host_metrics: dict[str, Any], n_sources: int) -> dict[str, float]:
+    """Flatten per-source EV into the reference's scalar names
+    (``explained_variance_A``/``_B`` for the 2-model case, ``trainer.py:58-60``;
+    indexed beyond that)."""
+    out: dict[str, float] = {}
+    letters = "ABCDEFGH"
+    for k, v in host_metrics.items():
+        if k == "explained_variance_per_source":
+            arr = np.asarray(v)
+            for i in range(n_sources):
+                name = f"explained_variance_{letters[i]}" if i < len(letters) else f"explained_variance_{i}"
+                out[name] = float(arr[i])
+        else:
+            out[k] = float(v)
+    return out
+
+
+class Trainer:
+    """Host-side loop around the compiled step.
+
+    Parameters
+    ----------
+    cfg: full config.
+    buffer: activation source with ``next()``; defaults to the synthetic
+        generator (tests/benchmarks) so the trainer is runnable end-to-end
+        with no LM in the loop (SURVEY.md §7 "minimum end-to-end slice").
+    mesh: optional pre-built device mesh (defaults to all devices, DP-only
+        unless ``cfg.model_axis_size`` says otherwise).
+    checkpointer: optional; see :mod:`crosscoder_tpu.checkpoint`.
+    """
+
+    def __init__(
+        self,
+        cfg: CrossCoderConfig,
+        buffer: Any | None = None,
+        mesh=None,
+        logger: MetricsLogger | None = None,
+        checkpointer: Any | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else mesh_lib.mesh_from_cfg(cfg)
+        if buffer is None:
+            from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+
+            buffer = SyntheticActivationSource(cfg)
+        self.buffer = buffer
+        self.logger = logger
+        self.checkpointer = checkpointer
+        self.total_steps = cfg.total_steps
+
+        self._tx = tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+        state = init_train_state(jax.random.key(cfg.seed), cfg, tx)
+        self._state_shardings = mesh_lib.state_shardings(self.mesh, state)
+        self.state = jax.device_put(state, self._state_shardings)
+        self._step_fn = make_train_step(cfg, self.mesh, tx, self._state_shardings)
+        self._batch_sharding = mesh_lib.batch_sharding(self.mesh)
+
+    def restore(self, version_dir=None, save: int | None = None) -> dict:
+        """Resume from a checkpoint: full TrainState + data-pipeline state
+        (the capability the reference lacks — its ``load`` is analysis-only,
+        reference crosscoder.py:207-217)."""
+        if self.checkpointer is None:
+            raise ValueError("Trainer has no checkpointer to restore from")
+        state, meta = self.checkpointer.restore(self.cfg, self._tx, version_dir, save)
+        self.state = jax.device_put(state, self._state_shardings)
+        if "buffer" in meta and hasattr(self.buffer, "load_state_dict"):
+            self.buffer.load_state_dict(meta["buffer"])
+        return meta
+
+    @property
+    def step_counter(self) -> int:
+        return int(self.state.step)
+
+    def step(self) -> dict[str, jax.Array]:
+        """One optimizer step; returns device-resident metrics (no sync)."""
+        batch = self.buffer.next()
+        batch = jax.device_put(batch, self._batch_sharding)
+        self.state, metrics = self._step_fn(self.state, batch)
+        return metrics
+
+    def log(self, metrics: dict[str, Any], step: int) -> None:
+        if self.logger is not None:
+            self.logger.log(expand_metrics(metrics, self.cfg.n_sources), step)
+
+    def save(self) -> None:
+        if self.checkpointer is not None:
+            self.checkpointer.save(self.state, self.cfg, buffer=self.buffer)
+
+    def train(self, num_steps: int | None = None) -> dict[str, float]:
+        """Run the training loop (reference ``trainer.py:72-82`` semantics:
+        periodic log/save, final save in ``finally``)."""
+        num_steps = self.total_steps if num_steps is None else num_steps
+        metrics: dict[str, Any] = {}
+        start = self.step_counter  # nonzero after restore()
+        progress = _progress_bar(start, num_steps)
+        try:
+            for i in progress:
+                metrics = self.step()
+                if i % self.cfg.log_every == 0:
+                    self.log(metrics, step=i)
+                if (i + 1) % self.cfg.save_every == 0:
+                    self.save()
+        finally:
+            self.save()
+            if self.logger is not None:
+                self.logger.close()
+        return expand_metrics(jax.device_get(metrics), self.cfg.n_sources) if metrics else {}
+
+
+def _progress_bar(start: int, n: int):
+    with contextlib.suppress(Exception):
+        import tqdm  # type: ignore
+
+        return tqdm.trange(start, n)
+    return range(start, n)

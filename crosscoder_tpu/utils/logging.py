@@ -1,0 +1,70 @@
+"""Metrics logging: the reference's wandb+print surface, made optional.
+
+The reference hard-requires wandb (``wandb.init`` at ``trainer.py:26``,
+``wandb.log`` + ``print`` at ``trainer.py:65-67``). Here the logger is a
+small strategy object selected by ``cfg.log_backend``:
+
+- ``wandb``: same behavior as the reference when wandb is importable and a
+  project is configured;
+- ``jsonl``: append one JSON object per log call to
+  ``<checkpoint_dir>/metrics.jsonl`` — the zero-dependency default for
+  air-gapped TPU pods;
+- ``null``: drop everything (benchmarks);
+- ``auto``: wandb if usable, else jsonl.
+
+The logged scalar set is exactly the reference's 9-key comparison surface
+(``trainer.py:51-61``): loss, l2_loss, l1_loss, l0_loss, l1_coeff, lr,
+explained_variance, explained_variance_A, explained_variance_B — with
+``explained_variance_{i}`` generalized beyond two sources.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Any
+
+
+class MetricsLogger:
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+        backend = cfg.log_backend
+        self._wandb = None
+        if backend == "wandb" and not cfg.wandb_project:
+            raise ValueError("log_backend='wandb' requires cfg.wandb_project")
+        if backend in ("auto", "wandb") and cfg.wandb_project:
+            try:
+                import wandb  # type: ignore
+
+                wandb.init(project=cfg.wandb_project, entity=cfg.wandb_entity or None)
+                self._wandb = wandb
+                backend = "wandb"
+            except Exception as e:  # offline pod, no creds, not installed
+                if cfg.log_backend == "wandb":
+                    raise
+                print(f"[crosscoder_tpu] wandb unavailable ({e}); falling back to jsonl")
+                backend = "jsonl"
+        elif backend == "auto":
+            backend = "jsonl"
+        self.backend = backend
+        self._file = None
+        if backend == "jsonl":
+            path = Path(cfg.checkpoint_dir)
+            path.mkdir(parents=True, exist_ok=True)
+            self._file = open(path / "metrics.jsonl", "a", buffering=1)
+
+    def log(self, metrics: dict[str, Any], step: int) -> None:
+        scalars = {k: float(v) for k, v in metrics.items()}
+        if self.backend == "wandb" and self._wandb is not None:
+            self._wandb.log(scalars, step=step)
+        elif self._file is not None:
+            self._file.write(json.dumps({"step": step, "time": time.time(), **scalars}) + "\n")
+        if self.backend != "null":
+            print({"step": step, **{k: round(v, 6) for k, v in scalars.items()}})
+
+    def close(self) -> None:
+        if self._wandb is not None:
+            self._wandb.finish()
+        if self._file is not None:
+            self._file.close()

@@ -1,0 +1,25 @@
+"""The driver contract: entry() compiles; dryrun_multichip runs a real
+sharded train step on the 8-virtual-device CPU mesh."""
+
+import sys
+from pathlib import Path
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import __graft_entry__ as ge
+
+
+def test_entry_returns_jittable():
+    fn, args = ge.entry()
+    lowered = jax.jit(fn).lower(*args)  # compile-check without running the big matmul
+    assert lowered is not None
+
+
+def test_dryrun_multichip_8():
+    ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_2():
+    ge.dryrun_multichip(2)

@@ -1,0 +1,61 @@
+"""Schedule parity: jnp schedules vs the reference formulas, including the
+actual lr trace a torch LambdaLR would produce (SURVEY.md §4)."""
+
+import numpy as np
+import torch
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.train import schedules
+
+from torch_oracle import oracle_l1_coeff, oracle_lr_lambda
+
+
+def cfg_with_steps(total_steps: int, **kw) -> CrossCoderConfig:
+    return CrossCoderConfig(num_tokens=total_steps * 64, batch_size=64, **kw)
+
+
+def test_lr_schedule_matches_reference_formula():
+    cfg = cfg_with_steps(1000, lr=5e-5)
+    f = schedules.lr_schedule(cfg)
+    for step in [0, 1, 399, 799, 800, 900, 999, 1000]:
+        expect = cfg.lr * oracle_lr_lambda(step, 1000)
+        np.testing.assert_allclose(float(f(step)), expect, rtol=3e-6)
+
+
+def test_l1_schedule_matches_reference_formula():
+    cfg = cfg_with_steps(1000, l1_coeff=2.0)
+    f = schedules.l1_coeff_schedule(cfg)
+    for step in [0, 1, 25, 49, 50, 51, 500, 999]:
+        expect = oracle_l1_coeff(step, 1000, 2.0)
+        np.testing.assert_allclose(float(f(step)), expect, rtol=3e-6)
+
+
+def test_lr_trace_matches_torch_lambdalr():
+    """The lr actually used on optimizer step i must match torch's LambdaLR
+    driven exactly as the reference drives it (scheduler.step() after each
+    optimizer step, trainer.py:47-48)."""
+    total = 50
+    cfg = cfg_with_steps(total, lr=1e-3)
+    f = schedules.lr_schedule(cfg)
+
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.Adam([p], lr=cfg.lr)
+    sched = torch.optim.lr_scheduler.LambdaLR(
+        opt, lambda step: 1.0 if step < 0.8 * total else 1.0 - (step - 0.8 * total) / (0.2 * total)
+    )
+    for i in range(total):
+        torch_lr = opt.param_groups[0]["lr"]  # lr applied at step i
+        np.testing.assert_allclose(float(f(i)), torch_lr, rtol=3e-6, err_msg=f"step {i}")
+        opt.step()
+        sched.step()
+
+
+def test_schedules_accept_traced_arrays():
+    import jax
+    import jax.numpy as jnp
+
+    cfg = cfg_with_steps(100)
+    f = schedules.lr_schedule(cfg)
+    g = schedules.l1_coeff_schedule(cfg)
+    out = jax.jit(lambda s: (f(s), g(s)))(jnp.asarray(90, jnp.int32))
+    assert np.isfinite(float(out[0])) and np.isfinite(float(out[1]))
