@@ -1,19 +1,168 @@
 """Pallas TPU kernel for the TopK sparse-encode inner loop.
 
-Placeholder gate for now: :func:`supported` returns False until the kernel
-lands, so :func:`crosscoder_tpu.ops.activations.topk` uses the dense
-``lax.top_k`` path everywhere. The kernel itself is built in a later stage
-(BASELINE.json config 2: TopK(k=32) at dict_size 2^15).
+BASELINE.json config 2 calls for TopK(k=32) at dict_size 2^15; the reference
+has only dense ReLU (reference ``crosscoder.py:76-77``), so this kernel has
+no reference counterpart — it is the "native tier" of the TPU build
+(SURVEY.md §2 native-code statement).
+
+Why a kernel at all: the dense path (``activations._topk_dense``) runs
+``lax.top_k`` over ``[batch, d_hidden]`` — a partial sort that materializes
+``[batch, k]`` values+indices in HBM and scatters them back into a fresh
+``[batch, d_hidden]`` output, three HBM round-trips of the full activation
+matrix. This kernel produces the masked activations in ONE fused pass over
+VMEM-resident tiles, with no sort and no scatter:
+
+- ReLU'd pre-acts are bitcast to int32. For non-negative IEEE-754 floats the
+  bit pattern is order-isomorphic to the value, so the k-th largest value's
+  bit pattern can be found by EXACT integer bisection: ~31 vectorized
+  compare-and-count sweeps over the tile (VPU work, all rows of the tile in
+  parallel), no data movement.
+- Ties at the k-th value are broken by lowest index — the same semantics as
+  ``lax.top_k`` — via a second exact bisection on the index axis (≤
+  ``log2(d_hidden)+1`` sweeps), so the kernel is bit-identical to the dense
+  oracle, which the tests assert.
+- The backward pass is a straight-through mask of the survivors (gradients
+  flow only where the output is nonzero), matching the dense path's
+  gradient, via ``jax.custom_vjp``.
+
+The kernel runs per row-block of shape ``(block_rows, d_hidden)`` held in
+VMEM; ``d_hidden`` must be lane-aligned (multiple of 128). ``supported``
+gates dispatch so unaligned/odd shapes fall back to the dense oracle.
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Target ~2 MB fp32 per VMEM buffer; a few live buffers stay well under the
+# ~16 MB/core budget. Row counts are multiples of 32 so the block's sublane
+# dimension satisfies every dtype's min-tile requirement (fp32 8, bf16 16,
+# int8/fp8 32).
+_TARGET_BLOCK_BYTES = 2 << 20
+_MIN_ROWS = 32
+
+
+def _block_rows(h_width: int, n_rows: int) -> int:
+    rows = _TARGET_BLOCK_BYTES // (h_width * 4) // _MIN_ROWS * _MIN_ROWS
+    rows = max(_MIN_ROWS, min(rows, 256))
+    # shrink to the smallest aligned block covering small inputs
+    while rows - _MIN_ROWS >= n_rows and rows > _MIN_ROWS:
+        rows -= _MIN_ROWS
+    return rows
 
 
 def supported(h: jax.Array, k: int) -> bool:
-    return False
+    """True when the kernel can handle this shape/dtype (dispatch gate used
+    by :func:`crosscoder_tpu.ops.activations.topk`)."""
+    if h.ndim < 1:
+        return False
+    width = h.shape[-1]
+    return (
+        width % 128 == 0
+        and width >= 256
+        and 0 < k < width
+        and h.dtype in (jnp.float32, jnp.bfloat16)
+    )
 
 
-def topk(h: jax.Array, k: int) -> jax.Array:  # pragma: no cover - gated off
-    raise NotImplementedError("pallas topk kernel not yet enabled")
+def _topk_mask_kernel(h_ref, out_ref, *, k: int, idx_iters: int):
+    """One row-block: exact top-k mask via bit-pattern bisection."""
+    hp = jnp.maximum(h_ref[:].astype(jnp.float32), 0.0)      # [R, H]
+    bits = jax.lax.bitcast_convert_type(hp, jnp.int32)        # monotone for hp >= 0
+    rows, width = hp.shape
+
+    # --- exact integer bisection for the k-th largest bit pattern --------
+    # invariant: count(bits >= lo) >= k  and  count(bits >= hi) < k
+    lo = jnp.zeros((rows, 1), jnp.int32)
+    hi = jnp.max(bits, axis=-1, keepdims=True) + 1
+
+    def bit_body(_, carry):
+        lo, hi = carry
+        mid = lo + (hi - lo) // 2
+        cnt = jnp.sum((bits >= mid).astype(jnp.int32), axis=-1, keepdims=True)
+        ge_k = cnt >= k
+        return jnp.where(ge_k, mid, lo), jnp.where(ge_k, hi, mid)
+
+    # 31 halvings cover the full non-negative int32 range
+    lo, hi = jax.lax.fori_loop(0, 31, bit_body, (lo, hi))
+    kth = lo                                                   # bits of v_k
+    mask_gt = bits > kth                                       # count < k
+
+    # --- tie-break by lowest index: keep first (k - count_gt) ties -------
+    c_gt = jnp.sum(mask_gt.astype(jnp.int32), axis=-1, keepdims=True)
+    r = k - c_gt                                               # ties to keep, >= 1
+    mask_eq = bits == kth
+    col = jax.lax.broadcasted_iota(jnp.int32, (rows, width), 1)
+
+    # smallest I with count(mask_eq & col < I) == r, by exact bisection
+    ilo = jnp.zeros((rows, 1), jnp.int32)
+    ihi = jnp.full((rows, 1), width, jnp.int32)
+
+    def idx_body(_, carry):
+        ilo, ihi = carry
+        mid = ilo + (ihi - ilo) // 2
+        cnt = jnp.sum(
+            (mask_eq & (col < mid)).astype(jnp.int32), axis=-1, keepdims=True
+        )
+        lt_r = cnt < r
+        return jnp.where(lt_r, mid, ilo), jnp.where(lt_r, ihi, mid)
+
+    ilo, ihi = jax.lax.fori_loop(0, idx_iters, idx_body, (ilo, ihi))
+
+    keep = mask_gt | (mask_eq & (col < ihi))
+    out_ref[:] = jnp.where(keep, hp, 0.0).astype(out_ref.dtype)
+
+
+def _topk_fwd_impl(h: jax.Array, k: int, interpret: bool) -> jax.Array:
+    lead = h.shape[:-1]
+    width = h.shape[-1]
+    flat = h.reshape(-1, width)
+    n_rows = flat.shape[0]
+    rows = _block_rows(width, n_rows)
+    pad = (-n_rows) % rows
+    if pad:
+        flat = jnp.pad(flat, ((0, pad), (0, 0)))
+    idx_iters = max(1, (width - 1).bit_length() + 1)
+
+    out = pl.pallas_call(
+        functools.partial(_topk_mask_kernel, k=k, idx_iters=idx_iters),
+        out_shape=jax.ShapeDtypeStruct(flat.shape, h.dtype),
+        grid=(flat.shape[0] // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, width), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((rows, width), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(flat)
+    if pad:
+        out = out[:n_rows]
+    return out.reshape(*lead, width)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def topk(h: jax.Array, k: int, interpret: bool = False) -> jax.Array:
+    """Fused exact top-k of the ReLU'd entries per row, zeros elsewhere.
+
+    Bit-identical to ``activations._topk_dense`` (ties by lowest index).
+    ``interpret=True`` runs the Pallas interpreter (CPU tests).
+    """
+    return _topk_fwd_impl(h, k, interpret)
+
+
+def _topk_vjp_fwd(h, k, interpret):
+    out = _topk_fwd_impl(h, k, interpret)
+    return out, out
+
+
+def _topk_vjp_bwd(k, interpret, out, g):
+    # straight-through on the survivors: same gradient as the dense path
+    # (scatter → relu), which passes g only where the kept value is > 0.
+    return (jnp.where(out > 0, g, 0).astype(g.dtype),)
+
+
+topk.defvjp(_topk_vjp_fwd, _topk_vjp_bwd)

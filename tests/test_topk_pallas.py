@@ -1,0 +1,68 @@
+"""Pallas TopK kernel vs. the dense ``lax.top_k`` oracle.
+
+The kernel's contract is bit-identical top-k selection (ties broken by
+lowest index, matching ``activations._topk_dense``); tests run the Pallas
+interpreter on CPU. No reference counterpart — the reference has dense ReLU
+only (reference crosscoder.py:76-77).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crosscoder_tpu.ops import activations as act
+from crosscoder_tpu.ops import topk_pallas
+
+
+def _dense(h, k):
+    return act._topk_dense(h, k)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("shape,k", [((8, 256), 4), ((24, 512), 32), ((3, 384), 7)])
+def test_matches_dense_oracle(shape, k, dtype):
+    h = jax.random.normal(jax.random.key(0), shape, dtype=dtype) * 2.0
+    out = topk_pallas.topk(h, k, interpret=True)
+    ref = _dense(h, k)
+    np.testing.assert_array_equal(np.asarray(out, np.float32), np.asarray(ref, np.float32))
+
+
+def test_ties_broken_by_lowest_index():
+    # bf16-style quantized values force many exact ties at the k-th value
+    h = jnp.asarray(
+        np.random.default_rng(3).integers(0, 4, size=(16, 256)).astype(np.float32)
+    )
+    out = topk_pallas.topk(h, 8, interpret=True)
+    ref = _dense(h, 8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_rows_with_few_positives():
+    h = -jnp.abs(jax.random.normal(jax.random.key(1), (8, 256)))
+    h = h.at[0, 3].set(1.0)  # row 0 has a single positive; others none
+    out = topk_pallas.topk(h, 4, interpret=True)
+    assert float(out[0, 3]) == 1.0
+    assert int((out > 0).sum()) == 1
+
+
+def test_leading_dims_and_padding():
+    # 5 rows (not a multiple of the block) across a leading batch dim
+    h = jax.random.normal(jax.random.key(2), (5, 3, 256))
+    out = topk_pallas.topk(h, 3, interpret=True)
+    ref = _dense(h, 3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_gradient_matches_dense():
+    h = jax.random.normal(jax.random.key(4), (8, 256))
+    g_pallas = jax.grad(lambda x: topk_pallas.topk(x, 5, True).sum())(h)
+    g_dense = jax.grad(lambda x: _dense(x, 5).sum())(h)
+    np.testing.assert_array_equal(np.asarray(g_pallas), np.asarray(g_dense))
+
+
+def test_supported_gate():
+    assert topk_pallas.supported(jnp.zeros((4, 512)), 32)
+    assert not topk_pallas.supported(jnp.zeros((4, 100)), 8)      # unaligned
+    assert not topk_pallas.supported(jnp.zeros((4, 512)), 512)    # k == width
+    assert not topk_pallas.supported(jnp.zeros((4, 512), jnp.int32), 8)
