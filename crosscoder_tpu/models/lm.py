@@ -1,0 +1,505 @@
+"""JAX Gemma-2 runtime with residual-stream capture and splicing.
+
+This module replaces the reference's entire "external model runtime" layer —
+TransformerLens ``HookedTransformer`` (reference ``train.py:45-55``,
+``buffer.py:81-89``, ``nb:cell 29``) — with a TPU-native functional LM:
+
+- ``forward(params, tokens, cfg, capture=..., edit=...)`` is ONE jittable,
+  mesh-shardable function. ``capture`` replaces ``run_with_cache(
+  names_filter=hook_point)``; ``edit`` replaces ``run_with_hooks(
+  fwd_hooks=[(hook_point, fn)])`` used by the CE-recovered eval
+  (reference ``nb:cell 29``'s ``splice_act_hook`` / ``zero_ablation_hook``).
+- Hook names follow the reference's TransformerLens strings
+  (``blocks.{L}.hook_resid_pre`` — reference ``train.py:32``) so configs and
+  analysis code carry over unchanged.
+
+TPU-first design decisions (why this is not a TransformerLens translation):
+
+- Layers are STACKED pytrees run under ``lax.scan`` — one traced block,
+  compiled once, instead of 26 unrolled layer graphs. Capture and editing
+  inside the scan use arithmetic masking on the layer index (each requested
+  layer matches exactly one slot of a preallocated capture buffer), so
+  arbitrary hook layers cost one fused multiply-add per layer and the graph
+  stays static — no Python callbacks in the hot path.
+- All attention/MLP matmuls are bf16 einsums with fp32 accumulation
+  (``preferred_element_type``) sized for the MXU; softmax/RMSNorm reductions
+  run in fp32.
+- Batch/sequence axes shard over the mesh ``data`` axis (harvest-side
+  sharding, SURVEY.md component N5); params are replicated by default
+  (Gemma-2-2B bf16 ≈ 5.2 GB/model fits one chip's HBM) — shardings are
+  expressed at the call site, not baked in here.
+
+Gemma-2 architecture facts implemented (validated against the HF
+``transformers`` Gemma2 implementation by ``tests/test_lm.py``): RMSNorm with
+(1+w) scaling in fp32; embedding scaled by sqrt(d_model); GeGLU MLP with
+tanh-approximate GELU; GQA; RoPE; attention-logit softcapping (50.0) and
+final-logit softcapping (30.0); alternating sliding-window/global attention
+(even layers local); query scale ``query_pre_attn_scalar**-0.5``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crosscoder_tpu.config import parse_hook_point
+from crosscoder_tpu.utils.dtypes import dtype_of
+
+LMParams = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LMConfig:
+    """Gemma-2 family architecture config."""
+
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    d_ff: int
+    rope_theta: float = 10_000.0
+    rms_eps: float = 1e-6
+    attn_softcap: float = 50.0
+    final_softcap: float = 30.0
+    sliding_window: int = 4096
+    query_pre_attn_scalar: float = 256.0
+    dtype: str = "bf16"
+
+    @classmethod
+    def gemma2_2b(cls) -> "LMConfig":
+        """Gemma-2-2B — the reference's subject model pair (train.py:10-12)."""
+        return cls(
+            vocab_size=256_000, d_model=2304, n_layers=26, n_heads=8,
+            n_kv_heads=4, head_dim=256, d_ff=9216, query_pre_attn_scalar=256.0,
+        )
+
+    @classmethod
+    def gemma2_9b(cls) -> "LMConfig":
+        """Gemma-2-9B (d_model 3584) — BASELINE scale-out config 3."""
+        return cls(
+            vocab_size=256_000, d_model=3584, n_layers=42, n_heads=16,
+            n_kv_heads=8, head_dim=256, d_ff=14_336, query_pre_attn_scalar=256.0,
+        )
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 257, n_layers: int = 4) -> "LMConfig":
+        """Deterministic test-sized config (the 'fake LM' of SURVEY.md §4 —
+        same hook semantics as the real model, no 2.6B-param download)."""
+        return cls(
+            vocab_size=vocab_size, d_model=32, n_layers=n_layers, n_heads=4,
+            n_kv_heads=2, head_dim=8, d_ff=64, sliding_window=8,
+            query_pre_attn_scalar=8.0, dtype="fp32",
+        )
+
+    def replace(self, **kw: Any) -> "LMConfig":
+        return dataclasses.replace(self, **kw)
+
+
+_NAMED_CONFIGS = {
+    "gemma-2-2b": LMConfig.gemma2_2b,
+    "gemma-2-2b-it": LMConfig.gemma2_2b,
+    "gemma-2-9b": LMConfig.gemma2_9b,
+    "gemma-2-9b-it": LMConfig.gemma2_9b,
+}
+
+
+def config_for(model_name: str) -> LMConfig:
+    """Architecture config by HF-style model name (reference train.py:25)."""
+    key = model_name.split("/")[-1].lower()
+    if key not in _NAMED_CONFIGS:
+        raise ValueError(f"unknown model {model_name!r}; known: {sorted(_NAMED_CONFIGS)}")
+    return _NAMED_CONFIGS[key]()
+
+
+# ---------------------------------------------------------------------------
+# params
+
+
+def init_params(key: jax.Array, cfg: LMConfig) -> LMParams:
+    """Random-init params (the fake-LM fixture; real runs use ``from_hf``).
+
+    Layer leaves are stacked on a leading [n_layers] axis for ``lax.scan``.
+    """
+    dt = dtype_of(cfg.dtype)
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    qd, kd = cfg.n_heads * cfg.head_dim, cfg.n_kv_heads * cfg.head_dim
+    ks = jax.random.split(key, 9)
+
+    def nrm(k, shape, scale):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dt)
+
+    return {
+        "embed": nrm(ks[0], (cfg.vocab_size, D), D ** -0.5),
+        "final_norm": jnp.zeros((D,), dt),
+        "layers": {
+            "attn_norm": jnp.zeros((L, D), dt),
+            "post_attn_norm": jnp.zeros((L, D), dt),
+            "pre_ffw_norm": jnp.zeros((L, D), dt),
+            "post_ffw_norm": jnp.zeros((L, D), dt),
+            "wq": nrm(ks[1], (L, D, qd), D ** -0.5),
+            "wk": nrm(ks[2], (L, D, kd), D ** -0.5),
+            "wv": nrm(ks[3], (L, D, kd), D ** -0.5),
+            "wo": nrm(ks[4], (L, qd, D), qd ** -0.5),
+            "w_gate": nrm(ks[5], (L, D, F), D ** -0.5),
+            "w_up": nrm(ks[6], (L, D, F), D ** -0.5),
+            "w_down": nrm(ks[7], (L, F, D), F ** -0.5),
+        },
+    }
+
+
+def param_count(cfg: LMConfig) -> int:
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    qd, kd = cfg.n_heads * cfg.head_dim, cfg.n_kv_heads * cfg.head_dim
+    per_layer = 4 * D + D * qd + 2 * D * kd + qd * D + 2 * D * F + F * D
+    return cfg.vocab_size * D + D + L * per_layer
+
+
+# ---------------------------------------------------------------------------
+# numerics
+
+
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    """Gemma RMSNorm: fp32 compute, (1 + w) scale."""
+    xf = x.astype(jnp.float32)
+    xf = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+    return (xf * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
+
+
+def _softcap(x: jax.Array, cap: float) -> jax.Array:
+    return cap * jnp.tanh(x / cap)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotate pairs (x[..., :d/2], x[..., d/2:]) — HF 'split-half' layout.
+
+    x: [B, S, n_heads, head_dim]; positions: [S].
+    """
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d // 2, dtype=jnp.float32) * 2.0 / d))
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]          # [S, d/2]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _attention(
+    x: jax.Array, lp: Mapping[str, jax.Array], cfg: LMConfig, is_local: jax.Array
+) -> jax.Array:
+    """One attention sublayer on [B, S, D]. ``is_local`` selects the
+    sliding-window mask (traced scalar — both masks are static precomputes)."""
+    B, S, D = x.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    pos = jnp.arange(S)
+
+    q = jnp.einsum("bsd,dq->bsq", x, lp["wq"], preferred_element_type=jnp.float32)
+    k = jnp.einsum("bsd,dk->bsk", x, lp["wk"], preferred_element_type=jnp.float32)
+    v = jnp.einsum("bsd,dk->bsk", x, lp["wv"], preferred_element_type=jnp.float32)
+    q = _rope(q.astype(x.dtype).reshape(B, S, H, hd), pos, cfg.rope_theta)
+    k = _rope(k.astype(x.dtype).reshape(B, S, KV, hd), pos, cfg.rope_theta)
+    v = v.astype(x.dtype).reshape(B, S, KV, hd)
+
+    # GQA: fold the group axis into the query head axis instead of repeating
+    # K/V (saves HBM traffic; XLA contracts over the shared kv head axis).
+    g = H // KV
+    q = q.reshape(B, S, KV, g, hd) * (cfg.query_pre_attn_scalar ** -0.5)
+    logits = jnp.einsum("bqkgh,bskh->bkgqs", q, k, preferred_element_type=jnp.float32)
+    if cfg.attn_softcap:
+        logits = _softcap(logits, cfg.attn_softcap)
+
+    causal = pos[:, None] >= pos[None, :]                                   # [S, S]
+    window = pos[:, None] - pos[None, :] < cfg.sliding_window
+    mask = jnp.where(is_local, causal & window, causal)
+    logits = jnp.where(mask[None, None, None], logits, -2.3819763e38)
+    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+
+    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, v, preferred_element_type=jnp.float32)
+    out = out.astype(x.dtype).reshape(B, S, H * hd)
+    return jnp.einsum("bsq,qd->bsd", out, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _mlp(x: jax.Array, lp: Mapping[str, jax.Array]) -> jax.Array:
+    """GeGLU: gelu_tanh(x·W_gate) ⊙ (x·W_up) · W_down."""
+    gate = jnp.einsum("bsd,df->bsf", x, lp["w_gate"], preferred_element_type=jnp.float32)
+    up = jnp.einsum("bsd,df->bsf", x, lp["w_up"], preferred_element_type=jnp.float32)
+    h = (jax.nn.gelu(gate, approximate=True) * up).astype(x.dtype)
+    return jnp.einsum("bsf,fd->bsd", h, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _block(resid: jax.Array, lp: Mapping[str, jax.Array], cfg: LMConfig, is_local: jax.Array) -> jax.Array:
+    """One Gemma-2 transformer block (sandwich norms around attn and MLP)."""
+    a = _attention(_rms_norm(resid, lp["attn_norm"], cfg.rms_eps), lp, cfg, is_local)
+    resid = resid + _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+    m = _mlp(_rms_norm(resid, lp["pre_ffw_norm"], cfg.rms_eps), lp)
+    return resid + _rms_norm(m, lp["post_ffw_norm"], cfg.rms_eps)
+
+
+# ---------------------------------------------------------------------------
+# hooks: capture + edits
+
+
+def splice_edit(resid: jax.Array, value: jax.Array) -> jax.Array:
+    """Replace all post-BOS positions, keep position 0 clean — the
+    reference's ``splice_act_hook`` (``act[:, 1:, :] = spliced_act``,
+    nb:cell 29)."""
+    return jnp.concatenate([resid[:, :1], value[:, 1:].astype(resid.dtype)], axis=1)
+
+
+def zero_edit(resid: jax.Array, value: jax.Array) -> jax.Array:
+    """Zero the whole hook activation — the reference's
+    ``zero_ablation_hook`` (nb:cell 29)."""
+    del value
+    return jnp.zeros_like(resid)
+
+
+def replace_edit(resid: jax.Array, value: jax.Array) -> jax.Array:
+    return value.astype(resid.dtype)
+
+
+@dataclass(frozen=True)
+class Edit:
+    """An activation intervention at one hook point.
+
+    ``fn(resid, value) -> resid`` must be shape-preserving and jit-pure;
+    ``value`` is a traced [B, S, d_model] operand (ignored by ``zero_edit``).
+    """
+
+    hook_point: str
+    fn: Callable[[jax.Array, jax.Array], jax.Array]
+    value: jax.Array | None = None
+
+
+def _hook_layers(cfg: LMConfig, hook_points: Sequence[str]) -> tuple[int, ...]:
+    """Map hook strings to capture layer indices. ``resid_pre`` of layer L is
+    the stream entering block L; ``resid_post`` of L is ``resid_pre`` of L+1
+    (the final layer's post-stream is captured as slot ``n_layers``)."""
+    layers = []
+    for hp in hook_points:
+        layer, site = parse_hook_point(hp)
+        if site == "resid_pre":
+            pass
+        elif site == "resid_post":
+            layer = layer + 1
+        else:
+            raise ValueError(f"unsupported hook site {site!r} (resid_pre/resid_post)")
+        if not 0 <= layer <= cfg.n_layers:
+            raise ValueError(f"hook layer {layer} out of range for {cfg.n_layers}-layer model")
+        layers.append(layer)
+    return tuple(layers)
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "capture", "edit_fns", "edit_layers", "return_logits"),
+)
+def _forward_impl(
+    params: LMParams,
+    tokens: jax.Array,
+    cfg: LMConfig,
+    capture: tuple[int, ...],
+    edit_fns: tuple[Callable, ...],
+    edit_layers: tuple[int, ...],
+    edit_values: tuple[jax.Array, ...],
+    return_logits: bool,
+):
+    B, S = tokens.shape
+    D = cfg.d_model
+    dt = dtype_of(cfg.dtype)
+
+    resid = params["embed"][tokens].astype(dt) * jnp.asarray(math.sqrt(D), dt)
+
+    n_cap = len(capture)
+    cap_arr = jnp.asarray(capture, dtype=jnp.int32) if n_cap else None
+    cap_buf = jnp.zeros((n_cap, B, S, D), dtype=dt) if n_cap else None
+    edit_arr = jnp.asarray(edit_layers, dtype=jnp.int32) if edit_layers else None
+
+    def apply_hooks(resid, i):
+        for j, fn in enumerate(edit_fns):
+            edited = fn(resid, edit_values[j])
+            resid = jnp.where(edit_arr[j] == i, edited, resid)
+        return resid
+
+    def capture_at(buf, resid, i):
+        if n_cap == 0:
+            return buf
+        match = (cap_arr == i).astype(dt)                   # one-hot over slots
+        return buf + match[:, None, None, None] * resid[None]
+
+    stacked = params["layers"]
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+
+    def body(carry, xs):
+        resid, buf = carry
+        lp, i = xs
+        resid = apply_hooks(resid, i)
+        buf = capture_at(buf, resid, i)
+        is_local = (i % 2) == 0                             # even layers: sliding window
+        resid = _block(resid, lp, cfg, is_local)
+        return (resid, buf), None
+
+    (resid, cap_buf), _ = jax.lax.scan(body, (resid, cap_buf), (stacked, layer_ids))
+    # virtual layer n_layers = final resid_post
+    resid = apply_hooks(resid, jnp.int32(cfg.n_layers))
+    cap_buf = capture_at(cap_buf, resid, jnp.int32(cfg.n_layers))
+
+    logits = None
+    if return_logits:
+        x = _rms_norm(resid, params["final_norm"], cfg.rms_eps)
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32)
+        if cfg.final_softcap:
+            logits = _softcap(logits, cfg.final_softcap)
+    return logits, cap_buf
+
+
+def forward(
+    params: LMParams,
+    tokens: jax.Array,
+    cfg: LMConfig,
+    *,
+    capture: Sequence[str] = (),
+    edits: Sequence[Edit] = (),
+    return_logits: bool = True,
+) -> tuple[jax.Array | None, dict[str, jax.Array]]:
+    """Run the LM; returns ``(logits, cache)``.
+
+    - ``capture``: hook-point strings to record — the ``run_with_cache(
+      names_filter=...)`` equivalent (reference buffer.py:81-89). The cache
+      maps each string to a [B, S, d_model] array.
+    - ``edits``: interventions applied to the residual stream BEFORE capture
+      at the same layer — the ``run_with_hooks`` equivalent (nb:cell 29).
+    - ``return_logits=False`` skips the unembedding (the d_model→256k matmul
+      dominates harvest FLOPs above the hook layer; harvesting never needs it).
+    """
+    cap_layers = _hook_layers(cfg, capture)
+    edit_layers = _hook_layers(cfg, [e.hook_point for e in edits])
+    edit_fns = tuple(e.fn for e in edits)
+    zeros = None
+    values = []
+    for e in edits:
+        if e.value is not None:
+            values.append(e.value)
+        else:
+            if zeros is None:
+                zeros = jnp.zeros((tokens.shape[0], tokens.shape[1], cfg.d_model), dtype_of(cfg.dtype))
+            values.append(zeros)
+    logits, cap_buf = _forward_impl(
+        params, tokens, cfg, cap_layers, edit_fns, edit_layers, tuple(values), return_logits
+    )
+    cache = {hp: cap_buf[i] for i, hp in enumerate(capture)}
+    return logits, cache
+
+
+def loss_fn(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy — TransformerLens ``return_type="loss"``
+    (the CE metric of the reference eval, nb:cell 29)."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def run_with_cache(
+    params: LMParams, tokens: jax.Array, cfg: LMConfig, hook_points: Sequence[str]
+) -> dict[str, jax.Array]:
+    """Capture-only forward (no unembedding) — the harvest primitive."""
+    _, cache = forward(params, tokens, cfg, capture=hook_points, return_logits=False)
+    return cache
+
+
+def ce_loss(
+    params: LMParams, tokens: jax.Array, cfg: LMConfig, edits: Sequence[Edit] = ()
+) -> jax.Array:
+    """CE of a (possibly intervened) forward — one number, on device."""
+    logits, _ = forward(params, tokens, cfg, edits=edits)
+    return loss_fn(logits, tokens)
+
+
+# ---------------------------------------------------------------------------
+# HF weight conversion (torch checkpoint → stacked JAX pytree)
+
+
+def from_torch_state_dict(sd: Mapping[str, Any], cfg: LMConfig, dtype: str | None = None) -> LMParams:
+    """Convert an HF-transformers Gemma2 ``state_dict`` to our stacked layout.
+
+    Works on anything indexable with ``.numpy()``-able values (torch CPU
+    tensors or numpy arrays). HF projections are [out, in]; ours are [in, out].
+    """
+    dt = dtype_of(dtype or cfg.dtype)
+
+    def get(name: str) -> np.ndarray:
+        v = sd[name]
+        if hasattr(v, "detach"):
+            v = v.detach().to("cpu").float().numpy()
+        return np.asarray(v, dtype=np.float32)
+
+    def stack(fmt: str, transpose: bool) -> jax.Array:
+        mats = [get(fmt.format(i)) for i in range(cfg.n_layers)]
+        arr = np.stack([m.T if transpose else m for m in mats])
+        return jnp.asarray(arr, dtype=dt)
+
+    p = "model.layers.{}."
+    return {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype=dt),
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype=dt),
+        "layers": {
+            "attn_norm": stack(p + "input_layernorm.weight", False),
+            "post_attn_norm": stack(p + "post_attention_layernorm.weight", False),
+            "pre_ffw_norm": stack(p + "pre_feedforward_layernorm.weight", False),
+            "post_ffw_norm": stack(p + "post_feedforward_layernorm.weight", False),
+            "wq": stack(p + "self_attn.q_proj.weight", True),
+            "wk": stack(p + "self_attn.k_proj.weight", True),
+            "wv": stack(p + "self_attn.v_proj.weight", True),
+            "wo": stack(p + "self_attn.o_proj.weight", True),
+            "w_gate": stack(p + "mlp.gate_proj.weight", True),
+            "w_up": stack(p + "mlp.up_proj.weight", True),
+            "w_down": stack(p + "mlp.down_proj.weight", True),
+        },
+    }
+
+
+def from_hf(model_name_or_path: str, cfg: LMConfig | None = None) -> tuple[LMParams, LMConfig]:
+    """Load Gemma-2 weights from a local HF checkpoint dir or the hub cache
+    (the reference loads via TransformerLens ``from_pretrained_no_processing``,
+    train.py:45-55). Gated behind an import so offline/test runs never touch
+    the hub."""
+    import transformers  # deferred: heavyweight
+
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        model_name_or_path, torch_dtype="bfloat16"  # keep host peak at ckpt size
+    )
+    hf_cfg = model.config
+    if cfg is None:
+        cfg = LMConfig(
+            vocab_size=hf_cfg.vocab_size,
+            d_model=hf_cfg.hidden_size,
+            n_layers=hf_cfg.num_hidden_layers,
+            n_heads=hf_cfg.num_attention_heads,
+            n_kv_heads=hf_cfg.num_key_value_heads,
+            head_dim=hf_cfg.head_dim,
+            d_ff=hf_cfg.intermediate_size,
+            rope_theta=hf_cfg.rope_theta,
+            rms_eps=hf_cfg.rms_norm_eps,
+            attn_softcap=hf_cfg.attn_logit_softcapping,
+            final_softcap=hf_cfg.final_logit_softcapping,
+            sliding_window=hf_cfg.sliding_window,
+            query_pre_attn_scalar=float(hf_cfg.query_pre_attn_scalar),
+        )
+    params = from_torch_state_dict(model.state_dict(), cfg)
+    return params, cfg

@@ -32,7 +32,10 @@ if TYPE_CHECKING:
 
 
 def relu(h: jax.Array) -> jax.Array:
-    return jnp.maximum(h, 0)
+    # jax.nn.relu, not jnp.maximum: its subgradient at exactly 0 is 0 (torch
+    # ReLU convention, and what the Pallas topk backward's survivor mask
+    # implements), where maximum would split the tie and pass 0.5·g.
+    return jax.nn.relu(h)
 
 
 def topk(h: jax.Array, k: int, *, use_pallas: bool | None = None) -> jax.Array:

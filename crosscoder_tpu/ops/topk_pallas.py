@@ -161,7 +161,9 @@ def _topk_vjp_fwd(h, k, interpret):
 
 def _topk_vjp_bwd(k, interpret, out, g):
     # straight-through on the survivors: same gradient as the dense path
-    # (scatter → relu), which passes g only where the kept value is > 0.
+    # (scatter → jax.nn.relu), which passes g only where the kept value is
+    # > 0 — survivors that are exactly 0.0 get no gradient in either path
+    # (relu's subgradient at 0 is 0).
     return (jnp.where(out > 0, g, 0).astype(g.dtype),)
 
 

@@ -1,0 +1,172 @@
+"""Parity tests for the JAX Gemma-2 runtime against HF transformers.
+
+The reference trusts TransformerLens for all LM execution (reference
+buffer.py:81-89, nb:cell 29); our runtime replaces that layer, so these tests
+gate it against the HF Gemma2 implementation on a tiny random config —
+logits, per-layer residual streams (capture parity), CE loss, and the
+edit/splice hook semantics used by the CE-recovered eval.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crosscoder_tpu.models import lm
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny_pair():
+    """(HF Gemma2 model, our params, our cfg) with identical weights."""
+    cfg = lm.LMConfig.tiny()
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.d_model,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim,
+        intermediate_size=cfg.d_ff,
+        sliding_window=cfg.sliding_window,
+        query_pre_attn_scalar=cfg.query_pre_attn_scalar,
+        attn_logit_softcapping=cfg.attn_softcap,
+        final_logit_softcapping=cfg.final_softcap,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_eps,
+        attention_dropout=0.0,
+        attn_implementation="eager",  # sdpa drops the logit softcap
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    model = transformers.Gemma2ForCausalLM(hf_cfg).eval()
+    params = lm.from_torch_state_dict(model.state_dict(), cfg, dtype="fp32")
+    return model, params, cfg
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(1)
+    return rng.integers(0, 257, size=(2, 16), dtype=np.int64)
+
+
+def _hf_forward(model, tokens):
+    with torch.no_grad():
+        out = model(torch.from_numpy(tokens), output_hidden_states=True)
+    return out
+
+
+def test_logits_parity(tiny_pair, tokens):
+    model, params, cfg = tiny_pair
+    hf = _hf_forward(model, tokens)
+    logits, _ = lm.forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(
+        np.asarray(logits), hf.logits.numpy(), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_resid_pre_capture_parity(tiny_pair, tokens):
+    """blocks.L.hook_resid_pre must equal HF hidden_states[L] for every L
+    (hidden_states[0] is the scaled embedding entering block 0), and the
+    final resid_post must equal hidden_states[n_layers]."""
+    model, params, cfg = tiny_pair
+    hf = _hf_forward(model, tokens)
+    hooks = [f"blocks.{i}.hook_resid_pre" for i in range(cfg.n_layers)]
+    hooks.append(f"blocks.{cfg.n_layers - 1}.hook_resid_post")
+    cache = lm.run_with_cache(params, jnp.asarray(tokens), cfg, hooks)
+    for i in range(cfg.n_layers):
+        name = hooks[i]
+        np.testing.assert_allclose(
+            np.asarray(cache[name]), hf.hidden_states[i].numpy(),
+            rtol=2e-4, atol=2e-4, err_msg=name,
+        )
+    # HF's final hidden_states entry is post-final-RMSNorm; our resid_post is
+    # the raw stream (TransformerLens semantics) — norm it before comparing.
+    final = lm._rms_norm(cache[hooks[-1]], params["final_norm"], cfg.rms_eps)
+    np.testing.assert_allclose(
+        np.asarray(final), hf.hidden_states[cfg.n_layers].numpy(),
+        rtol=2e-4, atol=2e-4, err_msg="final resid_post (normed)",
+    )
+
+
+def test_ce_loss_parity(tiny_pair, tokens):
+    """Our mean next-token CE matches torch cross_entropy on HF logits
+    (TransformerLens return_type='loss' semantics, nb:cell 29)."""
+    model, params, cfg = tiny_pair
+    hf = _hf_forward(model, tokens)
+    want = torch.nn.functional.cross_entropy(
+        hf.logits[:, :-1].reshape(-1, cfg.vocab_size),
+        torch.from_numpy(tokens)[:, 1:].reshape(-1),
+    ).item()
+    got = float(lm.ce_loss(params, jnp.asarray(tokens), cfg))
+    assert abs(got - want) < 1e-4
+
+
+def test_sliding_window_matters(tiny_pair, tokens):
+    """Degenerate check that the local/global alternation is live: growing
+    the window changes logits once S > window."""
+    _, params, cfg = tiny_pair
+    assert tokens.shape[1] > cfg.sliding_window
+    wide = cfg.replace(sliding_window=4 * cfg.sliding_window)
+    a, _ = lm.forward(params, jnp.asarray(tokens), cfg)
+    b, _ = lm.forward(params, jnp.asarray(tokens), wide)
+    assert not np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_splice_identity_edit(tiny_pair, tokens):
+    """Splicing the captured activation back in is a no-op — the fixed point
+    the CE-recovered eval relies on (nb:cell 29: spliced == clean when the
+    reconstruction is perfect)."""
+    _, params, cfg = tiny_pair
+    hp = "blocks.2.hook_resid_pre"
+    tok = jnp.asarray(tokens)
+    clean_logits, cache = lm.forward(params, tok, cfg, capture=[hp])
+    edit = lm.Edit(hp, lm.splice_edit, cache[hp])
+    spliced_logits, _ = lm.forward(params, tok, cfg, edits=[edit])
+    np.testing.assert_allclose(
+        np.asarray(spliced_logits), np.asarray(clean_logits), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_zero_ablation_edit(tiny_pair, tokens):
+    """zero_ablation_hook semantics: zeroing the hook layer changes the loss
+    and equals manually zeroing via replace_edit."""
+    _, params, cfg = tiny_pair
+    hp = "blocks.2.hook_resid_pre"
+    tok = jnp.asarray(tokens)
+    clean = float(lm.ce_loss(params, tok, cfg))
+    zeroed = float(lm.ce_loss(params, tok, cfg, edits=[lm.Edit(hp, lm.zero_edit)]))
+    assert zeroed != pytest.approx(clean, abs=1e-6)
+    zeros = jnp.zeros((tok.shape[0], tok.shape[1], cfg.d_model), jnp.float32)
+    replaced = float(
+        lm.ce_loss(params, tok, cfg, edits=[lm.Edit(hp, lm.replace_edit, zeros)])
+    )
+    assert zeroed == pytest.approx(replaced, abs=1e-6)
+
+
+def test_edit_then_capture_order(tiny_pair, tokens):
+    """Edits apply BEFORE capture at the same layer, matching TransformerLens
+    hook ordering (the eval splices and downstream sees the spliced value)."""
+    _, params, cfg = tiny_pair
+    hp = "blocks.1.hook_resid_pre"
+    tok = jnp.asarray(tokens)
+    _, cache = lm.forward(
+        params, tok, cfg, capture=[hp], edits=[lm.Edit(hp, lm.zero_edit)]
+    )
+    assert float(jnp.abs(cache[hp]).max()) == 0.0
+
+
+def test_param_count(tiny_pair):
+    _, params, cfg = tiny_pair
+    got = sum(int(np.prod(v.shape)) for v in jax.tree_util.tree_leaves(params))
+    assert got == lm.param_count(cfg)
+
+
+def test_config_for_names():
+    cfg = lm.config_for("google/gemma-2-2b")
+    assert (cfg.d_model, cfg.n_layers) == (2304, 26)
+    assert lm.config_for("gemma-2-2b-it") == cfg
+    with pytest.raises(ValueError):
+        lm.config_for("llama-3")

@@ -66,3 +66,14 @@ def test_supported_gate():
     assert not topk_pallas.supported(jnp.zeros((4, 100)), 8)      # unaligned
     assert not topk_pallas.supported(jnp.zeros((4, 512)), 512)    # k == width
     assert not topk_pallas.supported(jnp.zeros((4, 512), jnp.int32), 8)
+
+
+def test_gradient_parity_at_exact_zero_survivors():
+    """Rows with < k strictly-positive entries select exact-0.0 survivors;
+    neither path may pass gradient through them (relu subgradient at 0 is 0)."""
+    h = jnp.zeros((2, 256))
+    h = h.at[0, 7].set(3.0)
+    g_pallas = jax.grad(lambda x: topk_pallas.topk(x, 4, True).sum())(h)
+    g_dense = jax.grad(lambda x: _dense(x, 4).sum())(h)
+    np.testing.assert_array_equal(np.asarray(g_pallas), np.asarray(g_dense))
+    assert int((np.asarray(g_dense) != 0).sum()) == 1  # only the 3.0 entry
