@@ -87,6 +87,9 @@ class CrossCoderConfig:
     log_backend: str = "auto"       # auto | wandb | jsonl | null
     profile_dir: str = ""           # non-empty: write jax.profiler traces here
     remat: bool = False             # jax.checkpoint the encode for memory
+    data_source: str = "gemma"      # gemma (paired-LM harvest) | synthetic
+    model_names: tuple[str, ...] = ()  # HF ids to diff; default: (google/<model_name>, +"-it")
+    resume: bool = False            # resume from the latest checkpoint version
 
     # unknown keys from foreign cfg JSONs, preserved on round-trip
     extras: dict[str, Any] = field(default_factory=dict)
@@ -101,6 +104,10 @@ class CrossCoderConfig:
             raise ValueError("n_models must be >= 1")
         if isinstance(self.hook_points, list):
             self.hook_points = tuple(self.hook_points)
+        if isinstance(self.model_names, list):
+            self.model_names = tuple(self.model_names)
+        if self.data_source not in ("gemma", "synthetic"):
+            raise ValueError(f"data_source must be 'gemma' or 'synthetic', got {self.data_source!r}")
 
     # --- derived quantities -------------------------------------------------
     @property

@@ -1,0 +1,244 @@
+"""Paired-activation replay buffer: harvest, calibrate, shuffle, serve.
+
+Re-implements the reference ``Buffer`` (reference ``buffer.py:7-125``) with a
+TPU-native split of responsibilities:
+
+- **Harvest on device**: both models' residual streams at the hook point(s)
+  come from the jitted :func:`crosscoder_tpu.models.lm.run_with_cache`
+  forward (replacing TransformerLens ``run_with_cache``, reference
+  ``buffer.py:81-89``), batch-shardable over the mesh ``data`` axis.
+- **Buffer + shuffle on host**: the replay store is host RAM (bf16 numpy),
+  not HBM — the reference burns ~4.8 GB of GPU memory on it (reference
+  ``buffer.py:18-22``). Instead of physically permuting 4.8 GB every refresh
+  (reference ``buffer.py:111-113``'s on-GPU ``randperm`` gather), we keep
+  the store in harvest order and serve batches through a shuffled *index*
+  permutation — the same without-replacement sampling distribution, zero
+  large copies; only the 36 MB batch gather crosses host→device per step.
+
+Behavioral parity with the reference (each a deliberate keep, SURVEY.md §2
+"behavioral quirks"):
+
+- sizes: ``buffer_size = batch_size·buffer_mult`` rounded DOWN to a multiple
+  of ``seq_len−1`` (BOS rows are dropped; reference ``buffer.py:15-17,93``);
+- first ``refresh()`` fills the whole buffer, later ones refill only the
+  first half, so ~half of served rows are survivors of earlier refreshes
+  (reference ``buffer.py:70-74``);
+- ``next()`` triggers a refresh once the read pointer passes
+  ``buffer_size//2 − batch_size`` (reference ``buffer.py:121``);
+- per-source norm calibration ``sqrt(d_in)/mean_token_norm`` over
+  ``norm_calib_batches × model_batch_size`` sequences (reference
+  ``buffer.py:44-63``), applied multiplicatively in ``next()`` (reference
+  ``buffer.py:123-124``); calibration reads the same leading tokens the
+  first refresh consumes (reference ``buffer.py:26,51``);
+- ``next()`` returns fp32 rows ``[batch, n_sources, d_in]``.
+
+Additions the reference lacks: multi-source harvest (N models × L hook
+points in one pass — the source axis generalization, SURVEY components
+N4/N8), deterministic seeded shuffles, and ``state_dict``/``load_state_dict``
+so training can resume mid-stream (the reference cannot resume at all,
+SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import lm
+
+_BF16 = np.dtype(jnp.bfloat16.dtype)
+
+
+class PairedActivationBuffer:
+    """Serves shuffled paired activations for crosscoder training.
+
+    Parameters
+    ----------
+    cfg: framework config (sizes, hook points, calibration knobs).
+    lm_cfg: architecture of the harvested models.
+    model_params: one LM param pytree per model (reference: Gemma-2-2B base
+        and IT, ``train.py:45-55``). ``len(model_params)`` must equal
+        ``cfg.n_models``.
+    tokens: ``[n_seqs, seq_len]`` int array of pretokenized sequences (the
+        reference's global ``all_tokens``, ``utils.py:180-196``).
+    batch_sharding: optional ``NamedSharding`` for the harvest forward's
+        token batches (mesh ``data`` axis; component N5).
+    """
+
+    def __init__(
+        self,
+        cfg: CrossCoderConfig,
+        lm_cfg: lm.LMConfig,
+        model_params: Sequence[lm.LMParams],
+        tokens: np.ndarray | jax.Array,
+        batch_sharding: Any | None = None,
+        lazy: bool = False,
+    ) -> None:
+        if len(model_params) != cfg.n_models:
+            raise ValueError(f"got {len(model_params)} param sets for n_models={cfg.n_models}")
+        self.cfg = cfg
+        self.lm_cfg = lm_cfg
+        self.model_params = list(model_params)
+        self.tokens = np.asarray(tokens)
+        if self.tokens.ndim != 2 or self.tokens.shape[1] != cfg.seq_len:
+            raise ValueError(f"tokens must be [n_seqs, {cfg.seq_len}], got {self.tokens.shape}")
+        self.hook_points = cfg.resolved_hook_points()
+        self.batch_sharding = batch_sharding
+
+        rows_per_seq = cfg.seq_len - 1                      # BOS dropped
+        # reference buffer.py:15-17: round the row budget down to whole seqs
+        self.buffer_batches = cfg.batch_size * cfg.buffer_mult // rows_per_seq
+        self.buffer_size = self.buffer_batches * rows_per_seq
+        if self.buffer_size < 2 * cfg.batch_size:
+            raise ValueError(
+                f"buffer_size {self.buffer_size} < 2×batch_size; raise buffer_mult"
+            )
+
+        self._store = np.empty((self.buffer_size, cfg.n_sources, cfg.d_in), dtype=_BF16)
+        self._perm = np.arange(self.buffer_size)
+        self._rng = np.random.default_rng(cfg.seed)
+        self.pointer = 0            # read position in the permutation
+        self.token_pointer = 0      # next unharvested sequence
+        self.first = True
+        self._filled = False
+
+        # every harvest forward runs at this fixed sequence count: a multiple
+        # of the mesh data-axis size (sharding divisibility) >= the requested
+        # model_batch_size — one compile shape, ragged tails padded
+        data_axis = 1
+        if batch_sharding is not None:
+            data_axis = int(batch_sharding.mesh.shape.get("data", 1))
+        self._chunk_seqs = -(-cfg.model_batch_size // data_axis) * data_axis
+
+        if not lazy:
+            # lazy=True defers calibration+fill to load_state_dict() so a
+            # resumed run doesn't harvest the whole buffer twice
+            self.normalisation_factor = self._estimate_norm_scaling_factors()
+            self.refresh()
+
+    # ------------------------------------------------------------------
+    # harvest
+
+    def _harvest(self, token_batch: np.ndarray) -> np.ndarray:
+        """All sources' hook activations for one token chunk:
+        ``[B, S, n_sources, d_in]`` (source axis model-major, matching the
+        crosscoder's ``n_sources = n_models × n_hooked_layers``)."""
+        n = token_batch.shape[0]
+        if n != self._chunk_seqs:
+            # pad ragged chunks to the fixed harvest shape: keeps dim 0
+            # divisible by the mesh data axis and avoids per-shape recompiles
+            assert n < self._chunk_seqs, (n, self._chunk_seqs)
+            pad = np.broadcast_to(token_batch[:1], (self._chunk_seqs - n, *token_batch.shape[1:]))
+            token_batch = np.concatenate([token_batch, pad])
+        tok = jnp.asarray(token_batch)
+        if self.batch_sharding is not None:
+            tok = jax.device_put(tok, self.batch_sharding)
+        per_source = []
+        for params in self.model_params:
+            cache = lm.run_with_cache(params, tok, self.lm_cfg, self.hook_points)
+            per_source.extend(cache[hp] for hp in self.hook_points)
+        stacked = jnp.stack(per_source, axis=2)             # [B, S, n_sources, d]
+        return np.asarray(jax.device_get(stacked.astype(jnp.bfloat16)))[:n]
+
+    def _estimate_norm_scaling_factors(self) -> np.ndarray:
+        """Per-source ``sqrt(d_in) / mean_token_norm`` (reference
+        ``buffer.py:44-63``; adapted there from SAELens). Means include every
+        position, BOS included, as the reference's do. Under a sharded
+        harvest the mean is a global psum-mean — XLA inserts the collective
+        from the sharding (SURVEY component N1)."""
+        cfg = self.cfg
+        n_seqs = cfg.norm_calib_batches * cfg.model_batch_size
+        if n_seqs > self.tokens.shape[0]:
+            n_seqs = self.tokens.shape[0]
+        sums = np.zeros((cfg.n_sources,), dtype=np.float64)
+        count = 0
+        for start in range(0, n_seqs, self._chunk_seqs):
+            chunk = self.tokens[start: start + self._chunk_seqs][:n_seqs - start]
+            acts = self._harvest(chunk).astype(np.float32)  # [B, S, n, d]
+            norms = np.linalg.norm(acts, axis=-1)           # [B, S, n]
+            sums += norms.sum(axis=(0, 1))
+            count += norms.shape[0] * norms.shape[1]
+        mean_norm = sums / max(count, 1)
+        return (np.sqrt(cfg.d_in) / mean_norm).astype(np.float32)
+
+    def refresh(self) -> None:
+        """Overwrite the rows just served with fresh activations, re-shuffle.
+
+        First call fills the whole buffer; later calls refill half (reference
+        ``buffer.py:70-74``). Fresh rows land on the *served* permutation
+        positions ``_perm[:n_new]`` — matching the reference, which serves
+        its shuffled buffer from row 0 and overwrites exactly that region
+        (reference ``buffer.py:98-113``): no row is served twice within a
+        fill, and unserved survivors are never discarded unseen.
+        """
+        cfg = self.cfg
+        num_batches = self.buffer_batches if self.first else self.buffer_batches // 2
+        self.first = False
+        rows_per_seq = cfg.seq_len - 1
+        write = 0
+        for start in range(0, num_batches, self._chunk_seqs):
+            stop = min(start + self._chunk_seqs, num_batches)
+            chunk = self._take_tokens(stop - start)
+            acts = self._harvest(chunk)                     # [B, S, n, d]
+            acts = acts[:, 1:]                              # drop BOS (buffer.py:93)
+            rows = acts.reshape(-1, cfg.n_sources, cfg.d_in)
+            self._store[self._perm[write: write + rows.shape[0]]] = rows
+            write += rows.shape[0]
+        assert write == num_batches * rows_per_seq
+        self._perm = self._rng.permutation(self.buffer_size)
+        self.pointer = 0
+        self._filled = True
+
+    def _take_tokens(self, n: int) -> np.ndarray:
+        """Next ``n`` sequences, wrapping at the end of the corpus (the
+        reference would IndexError past 400M tokens; the wrap makes long
+        runs and small test corpora safe)."""
+        total = self.tokens.shape[0]
+        idx = (self.token_pointer + np.arange(n)) % total
+        self.token_pointer = (self.token_pointer + n) % total
+        return self.tokens[idx]
+
+    # ------------------------------------------------------------------
+    # serving
+
+    def next(self) -> np.ndarray:
+        """One training batch ``[batch_size, n_sources, d_in]`` fp32, norm
+        factors applied (reference ``buffer.py:115-125``)."""
+        cfg = self.cfg
+        if not self._filled:
+            raise RuntimeError(
+                "buffer was built lazy and never filled; call load_state_dict "
+                "(resume) or refresh() first"
+            )
+        idx = self._perm[self.pointer: self.pointer + cfg.batch_size]
+        out = self._store[idx].astype(np.float32)
+        self.pointer += cfg.batch_size
+        if self.pointer > self.buffer_size // 2 - cfg.batch_size:
+            self.refresh()                                   # buffer.py:121-122
+        return out * self.normalisation_factor[None, :, None]
+
+    # ------------------------------------------------------------------
+    # resume support (no reference counterpart)
+
+    def state_dict(self) -> dict[str, Any]:
+        """Stream-resume state. The ~5 GB store is NOT saved; on restore the
+        buffer is re-filled from the saved ``token_pointer``, so the resumed
+        run continues the token stream where it stopped (data coverage is
+        preserved; the in-flight half-buffer of rows is re-harvested rather
+        than replayed bit-for-bit)."""
+        return {
+            "token_pointer": int(self.token_pointer),
+            "rng_state": self._rng.bit_generator.state,
+            "normalisation_factor": self.normalisation_factor.tolist(),
+        }
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        self.normalisation_factor = np.asarray(state["normalisation_factor"], np.float32)
+        self.token_pointer = int(state["token_pointer"])
+        self._rng.bit_generator.state = state["rng_state"]
+        self.first = True
+        self.refresh()

@@ -1,0 +1,56 @@
+"""Pretokenized corpus loading with a disk cache.
+
+Re-implements the reference's ``load_pile_lmsys_mixed_tokens``
+(reference ``utils.py:180-196``): the corpus is
+``ckkissane/pile-lmsys-mix-1m-tokenized-gemma-2`` — 50% Pile / 50% LmSys
+chat, pretokenized for Gemma-2 at seq_len 1024 (reference ``README.md:21``,
+``nb:cell 24``). Like the reference, a local cache is preferred and the HF
+download happens once; unlike it (a bare ``except:`` around the whole
+cache path, ``utils.py:182-185``) failures are explicit.
+
+Cache formats, in preference order:
+
+- ``<data_dir>/<name>.npy`` — our cache (mmap-able; the 400M-token corpus
+  is ~800 MB of int32, and ``np.load(mmap_mode='r')`` lets the buffer read
+  sequence windows without holding the corpus in RAM);
+- ``<data_dir>/<name>.pt`` — the reference's torch cache, accepted as-is so
+  a machine that already ran the reference needs no re-download;
+- HF ``datasets`` (network), then both the ``.npy`` cache is written.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from crosscoder_tpu.config import CrossCoderConfig
+
+
+def load_pile_lmsys_mixed_tokens(
+    cfg: CrossCoderConfig, mmap: bool = True
+) -> np.ndarray:
+    """Token matrix ``[n_seqs, seq_len] int32``."""
+    name = cfg.dataset_name.split("/")[-1]
+    data_dir = Path(cfg.data_dir)
+    npy = data_dir / f"{name}.npy"
+    if npy.exists():
+        return np.load(npy, mmap_mode="r" if mmap else None)
+
+    pt = data_dir / f"{name}.pt"
+    if pt.exists():
+        import torch  # the reference's cache format (utils.py:186)
+
+        tokens = torch.load(pt, map_location="cpu").numpy()
+        return np.ascontiguousarray(tokens.astype(np.int32, copy=False))
+
+    print(f"[crosscoder_tpu] downloading {cfg.dataset_name} (first run only)")
+    import datasets  # deferred: network path
+
+    ds = datasets.load_dataset(cfg.dataset_name, split="train")
+    ds.set_format("numpy", columns=["input_ids"])
+    tokens = np.ascontiguousarray(ds["input_ids"].astype(np.int32, copy=False))
+    data_dir.mkdir(parents=True, exist_ok=True)
+    np.save(npy, tokens)
+    print(f"[crosscoder_tpu] cached {tokens.shape} tokens at {npy}")
+    return tokens

@@ -1,5 +1,5 @@
 """Model zoo: the crosscoder itself and the JAX Gemma-2 harvest runtime."""
 
-from crosscoder_tpu.models import crosscoder  # noqa: F401
+from crosscoder_tpu.models import crosscoder, lm  # noqa: F401
 
-__all__ = ["crosscoder"]
+__all__ = ["crosscoder", "lm"]

@@ -1,0 +1,77 @@
+"""End-to-end training entry point (the reference's ``train.py:main``).
+
+``python scripts/train.py --flags`` (or ``python -m crosscoder_tpu.train.main``)
+wires the whole stack: config from CLI (the reference's CLI path is dead
+code — ``run_training.sh:4`` forwards ``"$@"`` but ``train.py`` never
+parses argv; here flags work) → model pair + tokens → paired-activation
+buffer → mesh-sharded Trainer → versioned checkpoints.
+
+Reference flow being reproduced (``train.py:43-62``):
+load Gemma-2-2B base + IT → load token corpus → cfg with ``d_in`` injected
+from the model → ``Trainer(cfg, ...).train()``. Plus what it lacks:
+``--data-source synthetic`` trains the full skeleton with no LM in the loop
+(SURVEY.md §7 "minimum end-to-end slice"), and ``--resume true`` continues
+from the latest checkpoint (full TrainState + data stream).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.train.trainer import Trainer
+from crosscoder_tpu.utils.logging import MetricsLogger
+
+
+def build_buffer(cfg: CrossCoderConfig, mesh) -> tuple[Any, CrossCoderConfig]:
+    """Data source per ``cfg.data_source``; returns (buffer, cfg) with
+    ``d_in`` injected from the loaded model (reference train.py:38-40)."""
+    if cfg.data_source == "synthetic":
+        from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+
+        return SyntheticActivationSource(cfg), cfg
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crosscoder_tpu.data.buffer import PairedActivationBuffer
+    from crosscoder_tpu.data.tokens import load_pile_lmsys_mixed_tokens
+    from crosscoder_tpu.models import lm
+
+    names: Sequence[str] = cfg.model_names or (
+        f"google/{cfg.model_name}",
+        f"google/{cfg.model_name}-it",   # base vs instruction-tuned pair (train.py:45-55)
+    )
+    if len(names) != cfg.n_models:
+        raise ValueError(f"{len(names)} model names for n_models={cfg.n_models}")
+    lm_cfg = lm.config_for(names[0])
+    params_list = [lm.from_hf(n, lm_cfg)[0] for n in names]
+    cfg = cfg.replace(d_in=lm_cfg.d_model)
+    tokens = load_pile_lmsys_mixed_tokens(cfg)
+    buffer = PairedActivationBuffer(
+        cfg, lm_cfg, params_list, tokens,
+        batch_sharding=NamedSharding(mesh, P("data", None)),
+        lazy=cfg.resume,   # resume restores calibration + refills once, in restore()
+    )
+    return buffer, cfg
+
+
+def main(argv: list[str] | None = None) -> Trainer:
+    cfg = CrossCoderConfig.from_cli(argv)
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    buffer, cfg = build_buffer(cfg, mesh)
+    trainer = Trainer(
+        cfg, buffer, mesh=mesh,
+        logger=MetricsLogger(cfg),
+        checkpointer=Checkpointer(cfg=cfg),
+    )
+    if cfg.resume:
+        meta = trainer.restore()
+        print(f"[crosscoder_tpu] resumed at step {meta['step']}")
+    trainer.train()
+    return trainer
+
+
+if __name__ == "__main__":
+    main()

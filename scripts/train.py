@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Training launcher (the reference's ``run_training.sh`` without the
+hardcoded conda path — and with CLI flags that actually reach the config;
+the reference drops them, SURVEY.md component R10).
+
+Examples:
+    python scripts/train.py --data-source synthetic --num-tokens 4096000
+    python scripts/train.py --l1-coeff 2 --dict-size 16384
+    python scripts/train.py --resume true
+"""
+
+import sys
+
+from crosscoder_tpu.train.main import main
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,221 @@
+"""Tests for the paired-activation replay buffer (reference buffer.py:7-125
+semantics), driven by the tiny fake-LM fixture — no real model downloads
+(SURVEY.md §4 "fake-LM fixture")."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data.buffer import PairedActivationBuffer
+from crosscoder_tpu.models import lm
+
+
+SEQ = 17          # rows_per_seq = 16
+HP = "blocks.2.hook_resid_pre"
+
+
+@pytest.fixture(scope="module")
+def lm_pair():
+    cfg = lm.LMConfig.tiny()
+    pa = lm.init_params(jax.random.key(0), cfg)
+    pb = lm.init_params(jax.random.key(1), cfg)
+    return cfg, [pa, pb]
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(7)
+    return rng.integers(0, 257, size=(256, SEQ), dtype=np.int64)
+
+
+def make_cfg(**kw):
+    base = dict(
+        batch_size=32, buffer_mult=32, seq_len=SEQ, d_in=32, n_models=2,
+        model_batch_size=4, norm_calib_batches=2, hook_point=HP, seed=3,
+    )
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def buf(lm_pair, tokens):
+    lm_cfg, params = lm_pair
+    return PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+
+
+def test_size_accounting(buf):
+    """buffer_size = batch·mult rounded down to whole (seq_len−1)-row seqs
+    (reference buffer.py:15-17)."""
+    assert buf.buffer_batches == 32 * 32 // 16 == 64
+    assert buf.buffer_size == 64 * 16 == 1024
+    assert buf._store.shape == (1024, 2, 32)
+
+
+def test_first_fill_matches_direct_harvest(buf, lm_pair, tokens):
+    """Store rows (harvest order) == both models' hook acts with BOS dropped,
+    flattened (reference buffer.py:91-101)."""
+    lm_cfg, params = lm_pair
+    want = []
+    for p in params:
+        cache = lm.run_with_cache(p, tokens[:4], lm_cfg, [HP])
+        want.append(np.asarray(cache[HP].astype(jax.numpy.bfloat16), dtype=np.float32))
+    want = np.stack(want, axis=2)[:, 1:]                     # [4, S-1, 2, d]
+    want = want.reshape(-1, 2, 32)
+    got = buf._store[: want.shape[0]].astype(np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
+
+
+def test_norm_factor_formula(buf, lm_pair, tokens):
+    """factor = sqrt(d_in)/mean_token_norm per source, over the leading
+    calib sequences, BOS included (reference buffer.py:44-63)."""
+    lm_cfg, params = lm_pair
+    n_seqs = 2 * 4
+    norms = []
+    for p in params:
+        cache = lm.run_with_cache(p, tokens[:n_seqs], lm_cfg, [HP])
+        acts = np.asarray(cache[HP].astype(jax.numpy.bfloat16), dtype=np.float32)
+        norms.append(np.linalg.norm(acts, axis=-1).mean())
+    want = np.sqrt(32) / np.asarray(norms)
+    np.testing.assert_allclose(buf.normalisation_factor, want, rtol=2e-2)
+
+
+def test_next_shape_dtype_and_scaling(lm_pair, tokens):
+    lm_cfg, params = lm_pair
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    idx = b._perm[: 32].copy()
+    raw = b._store[idx].astype(np.float32)
+    out = b.next()
+    assert out.shape == (32, 2, 32) and out.dtype == np.float32
+    np.testing.assert_allclose(
+        out, raw * b.normalisation_factor[None, :, None], rtol=1e-6
+    )
+
+
+def test_refresh_cadence_and_half_refill(lm_pair, tokens):
+    """Refresh fires when the pointer passes buffer//2 − batch (reference
+    buffer.py:121); later refreshes harvest only half the seqs (buffer.py:70-74),
+    overwrite exactly the served permutation positions (reference
+    buffer.py:98-113 serves row 0.. and overwrites that region), and leave
+    unserved survivors untouched."""
+    lm_cfg, params = lm_pair
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    assert b.token_pointer == 64
+    perm_before = b._perm.copy()
+    store_before = b._store.copy()
+    steps = 0
+    while b.token_pointer == 64:                 # serve until refresh fires
+        served_end = b.pointer + 32
+        b.next()
+        steps += 1
+        assert steps < 100
+    # refresh threshold: pointer > 512 − 32 ⇒ after 16 serves of 32 rows
+    assert steps == 16
+    assert b.token_pointer == 64 + 32            # half refill: 32 more seqs
+    assert b.pointer == 0
+    # unserved survivors (old perm tail) are byte-identical; the served
+    # region was refilled with fresh rows
+    survivors = perm_before[512:]
+    np.testing.assert_array_equal(b._store[survivors], store_before[survivors])
+    refilled = perm_before[:512]
+    assert not np.array_equal(b._store[refilled], store_before[refilled])
+    # no row served twice: every served position lies in the refilled region
+    assert set(perm_before[:served_end]) <= set(refilled)
+
+
+def test_lazy_buffer_defers_harvest(lm_pair, tokens):
+    """lazy=True skips calibration+fill (the resume path must not harvest
+    the buffer twice); next() before load_state_dict is an error."""
+    lm_cfg, params = lm_pair
+    donor = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    state = donor.state_dict()
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens, lazy=True)
+    assert b.token_pointer == 0 and not b._filled
+    with pytest.raises(RuntimeError):
+        b.next()
+    b.load_state_dict(state)
+    assert b.next().shape == (32, 2, 32)
+
+
+def test_sharded_ragged_harvest(lm_pair, tokens):
+    """model_batch_size not divisible by the mesh data axis (the default
+    cfg on any 8-device TPU) must still harvest: chunks are padded to a
+    fixed shard-divisible shape and results match the unsharded buffer."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    lm_cfg, params = lm_pair
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("data", "model"))
+    sh = NamedSharding(mesh, P("data", None))
+    assert mesh.shape["data"] == 8
+    b = PairedActivationBuffer(make_cfg(model_batch_size=3), lm_cfg, params,
+                               tokens, batch_sharding=sh)
+    assert b._chunk_seqs == 8
+    ref = PairedActivationBuffer(make_cfg(model_batch_size=3), lm_cfg, params, tokens)
+    np.testing.assert_allclose(
+        b.normalisation_factor, ref.normalisation_factor, rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        b._store.astype(np.float32), ref._store.astype(np.float32),
+        rtol=1e-2, atol=1e-2,   # batch-shape-dependent bf16 rounding only
+    )
+
+
+def test_no_repeat_within_fill(lm_pair, tokens):
+    """Index-permutation serving = the reference's full-buffer shuffle:
+    rows served between refreshes are distinct storage rows."""
+    lm_cfg, params = lm_pair
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    seen = []
+    for _ in range(16):
+        seen.append(b._perm[b.pointer: b.pointer + 32])
+        b.next()
+    seen = np.concatenate(seen)
+    assert len(np.unique(seen)) == len(seen)
+
+
+def test_multi_source_hooks(lm_pair, tokens):
+    """Two hook points × two models → n_sources=4, model-major source order
+    (the N4/N8 generalization of the reference's hardcoded pair)."""
+    lm_cfg, params = lm_pair
+    cfg = make_cfg(hook_points=("blocks.1.hook_resid_pre", "blocks.3.hook_resid_pre"))
+    b = PairedActivationBuffer(cfg, lm_cfg, params, tokens)
+    assert cfg.n_sources == 4
+    assert b._store.shape == (1024, 4, 32)
+    cache = lm.run_with_cache(params[0], tokens[:4], lm_cfg, cfg.hook_points)
+    want = np.asarray(cache[cfg.hook_points[1]].astype(jax.numpy.bfloat16), np.float32)
+    got = b._store[: 4 * 16, 1].astype(np.float32).reshape(4, 16, 32)
+    np.testing.assert_allclose(got, want[:, 1:], rtol=1e-2, atol=1e-2)
+
+
+def test_resume_roundtrip(lm_pair, tokens):
+    """state_dict → fresh buffer → load_state_dict continues the token
+    stream at the saved position with the saved norm factors."""
+    lm_cfg, params = lm_pair
+    b1 = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    for _ in range(20):                          # crosses one refresh
+        b1.next()
+    state = b1.state_dict()
+    b2 = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    b2.load_state_dict(state)
+    assert b2.token_pointer == (int(state["token_pointer"]) + 64) % 256
+    np.testing.assert_array_equal(b2.normalisation_factor, b1.normalisation_factor)
+    out = b2.next()
+    assert out.shape == (32, 2, 32)
+
+
+def test_token_wraparound(lm_pair, tokens):
+    """The harvest wraps at the corpus end instead of the reference's
+    IndexError past its token budget."""
+    lm_cfg, params = lm_pair
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens[:80])
+    assert b.token_pointer == 64
+    while b.token_pointer == 64:
+        b.next()
+    assert b.token_pointer == (64 + 32) % 80
+
+
+def test_rejects_mismatched_models(lm_pair, tokens):
+    lm_cfg, params = lm_pair
+    with pytest.raises(ValueError):
+        PairedActivationBuffer(make_cfg(n_models=3), lm_cfg, params, tokens)

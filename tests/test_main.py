@@ -1,0 +1,81 @@
+"""End-to-end entry-point tests: CLI flags → full train loop → checkpoints
+→ resume (the reference's train.py flow, with the CLI actually wired)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data.tokens import load_pile_lmsys_mixed_tokens
+from crosscoder_tpu.train.main import main
+
+
+def _argv(tmp_path, extra=()):
+    return [
+        "--data-source", "synthetic",
+        "--batch-size", "64",
+        "--buffer-mult", "4",
+        "--num-tokens", "6400",           # 100 steps
+        "--d-in", "16",
+        "--dict-size", "256",
+        "--seq-len", "17",
+        "--lr", "3e-3",
+        "--log-backend", "jsonl",
+        "--log-every", "20",
+        "--save-every", "60",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+        *extra,
+    ]
+
+
+def test_main_synthetic_end_to_end(tmp_path):
+    trainer = main(_argv(tmp_path))
+    assert trainer.step_counter == 100
+    # versioned checkpoints: one at step 60 plus the finally-save
+    vdir = Checkpointer.latest_version_dir(tmp_path / "ckpt")
+    saves = sorted(int(p.stem) for p in vdir.glob("*.npz") if p.stem.isdigit())
+    assert saves == [0, 1]
+    # metrics jsonl has the reference's 9-scalar surface
+    lines = [
+        json.loads(ln)
+        for ln in (tmp_path / "ckpt" / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert {"loss", "l2_loss", "l1_loss", "l0_loss", "l1_coeff", "lr",
+            "explained_variance", "explained_variance_A",
+            "explained_variance_B"} <= set(lines[-1])
+    # training made progress on the synthetic ground-truth dictionary
+    assert lines[-1]["loss"] < lines[0]["loss"]
+
+
+def test_main_resume_continues(tmp_path):
+    main(_argv(tmp_path))
+    trainer = main(_argv(tmp_path, ["--resume", "true", "--num-tokens", "7680"]))
+    assert trainer.step_counter == 120          # 100 restored + 20 more
+    vdir = Checkpointer.latest_version_dir(tmp_path / "ckpt")
+    meta = json.loads(sorted(vdir.glob("*_meta.json"))[-1].read_text())
+    assert meta["step"] == 120
+
+
+def test_cli_rejects_bad_source(tmp_path):
+    with pytest.raises(ValueError):
+        main(_argv(tmp_path, ["--data-source", "nope"]))
+
+
+def test_tokens_loader_npy_cache(tmp_path):
+    cfg = CrossCoderConfig(data_dir=str(tmp_path), dataset_name="x/fake-corpus")
+    want = np.arange(6 * 1024, dtype=np.int32).reshape(6, 1024)
+    np.save(tmp_path / "fake-corpus.npy", want)
+    got = load_pile_lmsys_mixed_tokens(cfg)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_tokens_loader_accepts_reference_pt_cache(tmp_path):
+    torch = pytest.importorskip("torch")
+    cfg = CrossCoderConfig(data_dir=str(tmp_path), dataset_name="x/fake-corpus")
+    want = np.arange(4 * 1024, dtype=np.int64).reshape(4, 1024)
+    torch.save(torch.from_numpy(want), tmp_path / "fake-corpus.pt")
+    got = load_pile_lmsys_mixed_tokens(cfg)
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, want)
