@@ -1,0 +1,14 @@
+"""Analysis layer: decoder-space diffing and CE-recovered fidelity evals.
+
+Reproduces the reference's two result surfaces (SURVEY.md components
+R12/R13): the decoder-norm/cosine analyses of ``analysis.py`` and the
+CE-recovered splicing eval of the demo notebook (nb:cells 27-30)."""
+
+from crosscoder_tpu.analysis.decoder import (  # noqa: F401
+    cosine_sims,
+    decoder_norms,
+    relative_norms,
+    relative_norm_histogram,
+    shared_latent_mask,
+)
+from crosscoder_tpu.analysis.ce_eval import get_ce_recovered_metrics  # noqa: F401

@@ -1,0 +1,117 @@
+"""CE-recovered splicing eval — the repo's end-to-end fidelity metric.
+
+Reproduces ``get_ce_recovered_metrics`` from the reference notebook
+(nb:cell 29), the only quality metric with published numbers (SURVEY.md §6:
+CE recovered ≈ 0.922 base / 0.926 IT on the published checkpoint):
+
+per model m ∈ {A, B}:
+  - ``ce_clean``:   CE of the untouched forward
+  - ``ce_zero_abl``: CE with the hook activation zeroed (``zero_ablation_hook``)
+  - ``ce_spliced``: CE with post-BOS hook activations replaced by the
+    crosscoder reconstruction of BOTH models' streams (``splice_act_hook``
+    keeps the BOS position clean)
+  - ``ce_recovered = 1 − (spliced − clean) / (zero_abl − clean)``
+
+The crosscoder must be **folded** first (``fold_scaling_factors``,
+nb:cell 27) so it consumes raw — not norm-calibrated — activations.
+
+TPU shape of the computation: the three forwards per model and the
+crosscoder reconstruction are jitted device code (capture and splicing via
+:mod:`crosscoder_tpu.models.lm` edits); tokens stream through in fixed-size
+chunks (a ragged final chunk costs at most one extra compile — no sequences
+are dropped) and the CEs are sequence-weighted means over chunks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.models import lm
+from crosscoder_tpu.utils.logging import source_tag
+
+
+def crosscoder_reconstruct_fn(
+    params: cc.Params, cfg: CrossCoderConfig
+) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """rows ``[N, n_sources, d_in]`` → reconstructed rows, via the (folded)
+    crosscoder (nb:cell 29: ``cc.decode(cc.encode(x))``)."""
+
+    def fn(x: jnp.ndarray) -> jnp.ndarray:
+        return cc.forward(params, x, cfg)
+
+    return fn
+
+
+def get_ce_recovered_metrics(
+    tokens: np.ndarray,
+    lm_cfg: lm.LMConfig,
+    model_params: Sequence[lm.LMParams],
+    hook_point: str,
+    reconstruct: Callable[[jnp.ndarray], jnp.ndarray],
+    chunk: int = 4,
+) -> dict[str, float]:
+    """CE clean / zero-ablation / spliced / recovered, per model.
+
+    ``reconstruct`` maps flattened post-BOS rows ``[N, n_models, d_in]`` to
+    reconstructions (see :func:`crosscoder_reconstruct_fn`); injecting it
+    keeps the eval testable against exact oracles (identity ⇒ recovered=1,
+    zero ⇒ recovered=0) independent of any trained crosscoder.
+    """
+    n_models = len(model_params)
+    tokens = np.asarray(tokens)
+    if tokens.shape[0] < 1:
+        raise ValueError("need at least one token sequence")
+    sums = {m: {k: 0.0 for k in ("clean", "zero", "spliced")} for m in range(n_models)}
+    total_seqs = 0
+
+    for start in range(0, tokens.shape[0], chunk):
+        tok = jnp.asarray(tokens[start: start + chunk])   # ragged tail kept:
+        B, S = tok.shape                                   # seq-weighted below
+
+        # one forward per model yields BOTH the clean logits and the hook
+        # capture (the reference runs them separately, nb:cell 29)
+        clean_ce, caches = [], []
+        for p in model_params:
+            logits, cache = lm.forward(p, tok, lm_cfg, capture=[hook_point])
+            clean_ce.append(float(lm.loss_fn(logits, tok)))
+            caches.append(cache[hook_point])
+        # stack → drop BOS → flatten to rows, reconstruct, unflatten
+        acts = jnp.stack(caches, axis=2)[:, 1:]            # [B, S-1, n, d]
+        rows = acts.reshape(-1, n_models, lm_cfg.d_model).astype(jnp.float32)
+        recon_rows = reconstruct(rows)
+        recon = recon_rows.reshape(B, S - 1, n_models, lm_cfg.d_model)
+
+        for m, p in enumerate(model_params):
+            # splice_edit keeps BOS clean; pad recon back to S positions
+            spliced_act = jnp.concatenate(
+                [jnp.zeros_like(recon[:, :1, m]), recon[:, :, m]], axis=1
+            )
+            sums[m]["clean"] += B * clean_ce[m]
+            sums[m]["zero"] += B * float(
+                lm.ce_loss(p, tok, lm_cfg, edits=[lm.Edit(hook_point, lm.zero_edit)])
+            )
+            sums[m]["spliced"] += B * float(
+                lm.ce_loss(
+                    p, tok, lm_cfg,
+                    edits=[lm.Edit(hook_point, lm.splice_edit, spliced_act)],
+                )
+            )
+        total_seqs += B
+
+    out: dict[str, float] = {}
+    for m in range(n_models):
+        tag = source_tag(m)
+        clean = sums[m]["clean"] / total_seqs
+        zero = sums[m]["zero"] / total_seqs
+        spliced = sums[m]["spliced"] / total_seqs
+        out[f"ce_clean_{tag}"] = clean
+        out[f"ce_zero_abl_{tag}"] = zero
+        out[f"ce_spliced_{tag}"] = spliced
+        out[f"ce_diff_{tag}"] = spliced - clean
+        out[f"ce_recovered_{tag}"] = 1.0 - (spliced - clean) / (zero - clean)
+    return out

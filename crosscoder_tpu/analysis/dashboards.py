@@ -1,0 +1,186 @@
+"""Feature-centric latent dashboards — the sae_vis-equivalent (R14).
+
+The reference outsources dashboards to an external fork
+(``ckkissane/sae_vis@crosscoder-vis``, nb:cells 33-42): per latent, the top
+activating sequences as token heatmaps, the activation distribution, and
+the crosscoder's decoder-geometry stats, emitted as feature-centric HTML.
+This module is that capability natively, with the same workflow shape
+(``FeatureVisConfig`` / ``FeatureVisData.create(...)`` →
+``save_feature_centric_vis`` mirrors the fork's ``SaeVisConfig`` /
+``SaeVisData.create`` → ``save_feature_centric_vis``, nb:cells 36-42) and
+no torch/plotly/network dependencies.
+
+How it computes (all device work jitted, token-minibatched at a fixed
+shape): harvest both models' hook acts per minibatch → folded-crosscoder
+``encode`` → latent activations ``[B, S-1, features]`` — from which top-k
+sequences, per-token values, activation density, and per-feature stats
+fall out. The crosscoder must be the FOLDED one if activations are raw
+(nb:cell 27; see ``fold_scaling_factors``).
+"""
+
+from __future__ import annotations
+
+import html as _html
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crosscoder_tpu.analysis import decoder as dec_analysis
+from crosscoder_tpu.analysis.plots import (
+    default_token_renderer,
+    svg_histogram,
+    tokens_to_html,
+)
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.models import lm
+
+
+@dataclass
+class FeatureVisConfig:
+    """Mirrors the knobs the notebook sets on the sae_vis fork (nb:cell 36)."""
+
+    hook_point: str
+    features: tuple[int, ...]
+    minibatch_size_tokens: int = 4       # sequences per harvest forward
+    top_k_sequences: int = 8             # heatmap rows per feature
+    window: int = 24                     # tokens shown around the peak
+
+    def __post_init__(self) -> None:
+        self.features = tuple(int(f) for f in self.features)
+
+
+@dataclass
+class FeatureData:
+    feature: int
+    max_act: float
+    frac_active: float                   # fraction of tokens with act > 0
+    relative_norm: float                 # r of this latent (analysis.py:12)
+    cosine_sim: float
+    acts_sample: np.ndarray              # nonzero activations (density plot)
+    top_seqs: list[dict] = field(default_factory=list)
+    # each: {tokens: [int], values: [float], peak: int}
+
+
+class FeatureVisData:
+    """Computed dashboard data; render with ``save_feature_centric_vis``."""
+
+    def __init__(self, vis_cfg: FeatureVisConfig, features: list[FeatureData]) -> None:
+        self.cfg = vis_cfg
+        self.features = features
+
+    @classmethod
+    def create(
+        cls,
+        cc_params: cc.Params,
+        cc_cfg: CrossCoderConfig,
+        lm_cfg: lm.LMConfig,
+        model_params: Sequence[lm.LMParams],
+        tokens: np.ndarray,
+        vis_cfg: FeatureVisConfig,
+    ) -> "FeatureVisData":
+        feats = jnp.asarray(vis_cfg.features)
+        rel = np.asarray(dec_analysis.relative_norms(cc_params))[list(vis_cfg.features)]
+        cos = np.asarray(dec_analysis.cosine_sims(cc_params))[list(vis_cfg.features)]
+
+        @jax.jit
+        def latent_acts(tok: jax.Array) -> jax.Array:
+            caches = [
+                lm.run_with_cache(p, tok, lm_cfg, [vis_cfg.hook_point])[vis_cfg.hook_point]
+                for p in model_params
+            ]
+            x = jnp.stack(caches, axis=2)[:, 1:]            # drop BOS
+            f = cc.encode(cc_params, x.astype(jnp.float32), cc_cfg)
+            return f[..., feats]                            # [B, S-1, n_feats]
+
+        tokens = np.asarray(tokens)
+        mb = vis_cfg.minibatch_size_tokens
+        all_acts = []
+        for start in range(0, tokens.shape[0], mb):
+            # ragged tail included (one extra compile at most, no data dropped)
+            all_acts.append(np.asarray(latent_acts(jnp.asarray(tokens[start: start + mb]))))
+        acts = np.concatenate(all_acts)                     # [N, S-1, n_feats]
+
+        out = []
+        for fi, feat in enumerate(vis_cfg.features):
+            a = acts[..., fi]                               # [N, S-1]
+            peak_per_seq = a.max(axis=1)
+            order = np.argsort(-peak_per_seq)[: vis_cfg.top_k_sequences]
+            seqs = []
+            for si in order:
+                if peak_per_seq[si] <= 0:
+                    continue
+                peak = int(a[si].argmax())
+                lo = max(0, peak + 1 - vis_cfg.window // 2)
+                hi = min(tokens.shape[1], lo + vis_cfg.window)
+                seqs.append({
+                    # +1: activation col j scores token j+1 (BOS dropped)
+                    "tokens": tokens[si, lo:hi].tolist(),
+                    "values": np.concatenate([[0.0], a[si]])[lo:hi].tolist(),
+                    "peak": peak + 1 - lo,
+                })
+            nz = a[a > 0]
+            out.append(FeatureData(
+                feature=int(feat),
+                max_act=float(a.max()),
+                frac_active=float((a > 0).mean()),
+                relative_norm=float(rel[fi]),
+                cosine_sim=float(cos[fi]),
+                acts_sample=nz[:10_000],
+                top_seqs=seqs,
+            ))
+        return cls(vis_cfg, out)
+
+    # -- rendering ----------------------------------------------------------
+    def save_feature_centric_vis(
+        self, path: str | Path, decode_fn: Callable[[int], str] | None = None
+    ) -> Path:
+        """Write one self-contained HTML file (nb:cell 42 equivalent)."""
+        render = default_token_renderer(decode_fn)
+        cards = []
+        for fd in self.features:
+            rows = []
+            for seq in fd.top_seqs:
+                strs = [render(t) for t in seq["tokens"]]
+                rows.append(
+                    f'<div class="seq">{tokens_to_html(strs, seq["values"], vmax=fd.max_act)}'
+                    f' <span class="peak">max {max(seq["values"]):.2f}</span></div>'
+                )
+            hist = (
+                svg_histogram(fd.acts_sample) if fd.acts_sample.size else "<i>never active</i>"
+            )
+            cards.append(f"""
+<div class="card">
+  <h2>feature {fd.feature}</h2>
+  <table class="stats">
+    <tr><td>max act</td><td>{fd.max_act:.3f}</td>
+        <td>active frac</td><td>{fd.frac_active:.4%}</td></tr>
+    <tr><td>relative dec norm</td><td>{fd.relative_norm:.3f}</td>
+        <td>dec cosine</td><td>{fd.cosine_sim:.3f}</td></tr>
+  </table>
+  <div class="hist">{hist}</div>
+  <div class="seqs">{"".join(rows) or "<i>no activating sequences in sample</i>"}</div>
+</div>""")
+        doc = f"""<!doctype html><html><head><meta charset="utf-8">
+<title>crosscoder feature dashboards</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 1.5em; background: #fafafa; }}
+ .card {{ background: #fff; border: 1px solid #ddd; border-radius: 8px;
+          padding: 1em 1.2em; margin-bottom: 1.2em; max-width: 900px; }}
+ .seq {{ font-family: ui-monospace, monospace; font-size: 13px; margin: .35em 0;
+         white-space: nowrap; overflow-x: auto; }}
+ .peak {{ color: #888; font-size: 11px; }}
+ .stats td {{ padding: 0 1em 0 0; color: #444; font-size: 13px; }}
+ h2 {{ margin: .2em 0 .5em; font-size: 16px; }}
+</style></head><body>
+<h1>crosscoder feature dashboards</h1>
+<p>{_html.escape(self.cfg.hook_point)} · {len(self.features)} features</p>
+{"".join(cards)}
+</body></html>"""
+        path = Path(path)
+        path.write_text(doc)
+        return path

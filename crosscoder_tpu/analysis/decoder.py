@@ -1,0 +1,67 @@
+"""Decoder-space model-diff analysis (reference ``analysis.py:1-59``).
+
+The reference's headline result is read off the decoder geometry alone:
+
+- the **relative decoder norm** ``‖dec_B‖ / (‖dec_A‖ + ‖dec_B‖)`` per latent
+  separates three clusters — base-only (≈0), shared (≈0.5), IT-only (≈1)
+  (reference ``analysis.py:9-32``, nb:cell 18);
+- **shared latents** are the band ``0.3 < r < 0.7`` (``analysis.py:35``);
+- on shared latents, the **cosine similarity** of the paired decoder rows is
+  near 1 (``analysis.py:40-58``, log-y histogram).
+
+Everything here returns arrays (jit-friendly, fp32); rendering lives in
+:mod:`crosscoder_tpu.analysis.plots` so analysis runs headless on a pod.
+All functions take the generalized source axis: for >2 sources pass the
+pair to compare via ``pair=(i, j)`` (reference hardcodes sources (0, 1)).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from crosscoder_tpu.models.crosscoder import Params
+
+
+def decoder_norms(params: Params) -> jnp.ndarray:
+    """Per-(latent, source) decoder row norms ``[d_hidden, n_sources]``
+    (reference ``analysis.py:9``)."""
+    return jnp.linalg.norm(params["W_dec"].astype(jnp.float32), axis=-1)
+
+
+def relative_norms(params: Params, pair: tuple[int, int] = (0, 1)) -> jnp.ndarray:
+    """``‖dec_j‖ / (‖dec_i‖ + ‖dec_j‖)`` per latent, in [0, 1]
+    (reference ``analysis.py:12``: source 1 over the pair sum)."""
+    norms = decoder_norms(params)
+    i, j = pair
+    return norms[:, j] / (norms[:, i] + norms[:, j] + 1e-12)
+
+
+def shared_latent_mask(
+    params: Params, pair: tuple[int, int] = (0, 1),
+    low: float = 0.3, high: float = 0.7,
+) -> jnp.ndarray:
+    """Boolean ``[d_hidden]`` mask of latents shared between the pair —
+    the reference's ``0.3 < r < 0.7`` band (``analysis.py:35``)."""
+    r = relative_norms(params, pair)
+    return (r > low) & (r < high)
+
+
+def cosine_sims(params: Params, pair: tuple[int, int] = (0, 1)) -> jnp.ndarray:
+    """Cosine similarity of each latent's paired decoder rows ``[d_hidden]``
+    (reference ``analysis.py:40-47``; typically inspected on the shared
+    mask)."""
+    w = params["W_dec"].astype(jnp.float32)
+    i, j = pair
+    a, b = w[:, i], w[:, j]
+    na = jnp.linalg.norm(a, axis=-1)
+    nb = jnp.linalg.norm(b, axis=-1)
+    return jnp.sum(a * b, axis=-1) / (na * nb + 1e-12)
+
+
+def relative_norm_histogram(
+    params: Params, pair: tuple[int, int] = (0, 1), bins: int = 200
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(counts, edges) over [0, 1] — the 3-cluster histogram data
+    (reference ``analysis.py:16-32`` uses 200 bins)."""
+    r = relative_norms(params, pair)
+    return jnp.histogram(r, bins=bins, range=(0.0, 1.0))

@@ -1,0 +1,125 @@
+"""Plot helpers (reference ``utils.py:45-147``), rendering made optional.
+
+The reference wires plotly+IPython into its import hub, so analysis cannot
+run headless. Here every figure has two paths:
+
+- ``*_figure`` helpers return plotly figures when plotly is importable
+  (same call shapes as the reference's ``imshow``/``line``/``scatter``/
+  ``bar`` wrappers with ``x=``/``y=``/``title=`` kwargs);
+- data stays numpy, and the token heatmap (the reference's ``create_html``,
+  ``utils.py:96-147``) renders to a self-contained HTML string with zero
+  dependencies — it is also the building block of the latent dashboards.
+"""
+
+from __future__ import annotations
+
+import html as _html
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+def _plotly():
+    try:
+        import plotly.express as px  # type: ignore
+
+        return px
+    except Exception as e:  # not installed on the pod
+        raise ImportError(
+            "plotly is not available; use the data-returning analysis "
+            "functions or the HTML renderers instead"
+        ) from e
+
+
+def imshow(array: Any, **kwargs: Any):
+    """Heatmap (reference ``utils.py:48-53``: px.imshow with RdBu/zero-center)."""
+    px = _plotly()
+    kwargs.setdefault("color_continuous_scale", "RdBu")
+    kwargs.setdefault("color_continuous_midpoint", 0.0)
+    return px.imshow(np.asarray(array), **kwargs)
+
+
+def line(y: Any, **kwargs: Any):
+    px = _plotly()
+    return px.line(y=np.asarray(y), **kwargs)
+
+
+def scatter(x: Any, y: Any, **kwargs: Any):
+    px = _plotly()
+    return px.scatter(x=np.asarray(x), y=np.asarray(y), **kwargs)
+
+
+def bar(y: Any, **kwargs: Any):
+    px = _plotly()
+    return px.bar(y=np.asarray(y), **kwargs)
+
+
+def histogram(x: Any, **kwargs: Any):
+    """px.histogram wrapper — the reference's relative-norm and cosine-sim
+    figures (``analysis.py:16-32,48-58``; the latter uses log_y=True)."""
+    px = _plotly()
+    return px.histogram(x=np.asarray(x), **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# dependency-free HTML rendering
+
+
+def _act_color(v: float, vmax: float) -> str:
+    """White → orange background by activation magnitude (sae_vis style)."""
+    if vmax <= 0:
+        return "#ffffff"
+    t = max(0.0, min(1.0, v / vmax))
+    r, g, b = 255, int(237 - t * 90), int(217 - t * 190)
+    return f"rgb({r},{g},{b})"
+
+
+def tokens_to_html(
+    token_strs: Sequence[str],
+    values: Sequence[float],
+    vmax: float | None = None,
+) -> str:
+    """One sequence as an inline token heatmap — the reference's
+    ``create_html`` (``utils.py:96-147``): token background encodes the
+    per-token value, hover shows the number; newlines become visible '↵'."""
+    vals = np.asarray(values, dtype=np.float32)
+    vmax = float(vals.max()) if vmax is None else vmax
+    spans = []
+    for tok, v in zip(token_strs, vals):
+        shown = tok.replace("\n", "↵")
+        spans.append(
+            f'<span title="{float(v):.3f}" style="background:{_act_color(float(v), vmax)};'
+            f'border-radius:2px;padding:0 1px">{_html.escape(shown)}</span>'
+        )
+    return "".join(spans)
+
+
+def svg_histogram(
+    values: Sequence[float], bins: int = 40, width: int = 360, height: int = 80,
+    color: str = "#e8833a",
+) -> str:
+    """Tiny dependency-free SVG bar histogram (dashboard activation
+    distributions)."""
+    vals = np.asarray(values, dtype=np.float32)
+    counts, edges = np.histogram(vals, bins=bins)
+    peak = max(int(counts.max()), 1)
+    bw = width / bins
+    bars = []
+    for i, c in enumerate(counts):
+        h = height * int(c) / peak
+        bars.append(
+            f'<rect x="{i * bw:.1f}" y="{height - h:.1f}" width="{bw - 1:.1f}" '
+            f'height="{h:.1f}" fill="{color}"><title>'
+            f"[{edges[i]:.3g}, {edges[i + 1]:.3g}): {int(c)}</title></rect>"
+        )
+    return (
+        f'<svg width="{width}" height="{height}" '
+        f'xmlns="http://www.w3.org/2000/svg">{"".join(bars)}</svg>'
+    )
+
+
+def default_token_renderer(decode_fn: Callable[[int], str] | None):
+    """Token-id → display string; without a tokenizer, ids render as ⟨id⟩."""
+    if decode_fn is None:
+        return lambda tid: f"⟨{int(tid)}⟩"
+    return lambda tid: decode_fn(int(tid))

@@ -226,12 +226,15 @@ class PairedActivationBuffer:
 
     def state_dict(self) -> dict[str, Any]:
         """Stream-resume state. The ~5 GB store is NOT saved; on restore the
-        buffer is re-filled from the saved ``token_pointer``, so the resumed
-        run continues the token stream where it stopped (data coverage is
-        preserved; the in-flight half-buffer of rows is re-harvested rather
-        than replayed bit-for-bit)."""
+        buffer re-fills starting from the saved token pointer REWOUND by the
+        sequences whose rows were harvested but not yet served, so no token's
+        activations are dropped unseen by a save/resume cycle (some
+        already-served tokens near the save point are re-harvested instead —
+        the safe direction for training data)."""
+        rows_per_seq = self.cfg.seq_len - 1
+        unserved_seqs = -(-(self.buffer_size - self.pointer) // rows_per_seq)
         return {
-            "token_pointer": int(self.token_pointer),
+            "token_pointer": int((self.token_pointer - unserved_seqs) % self.tokens.shape[0]),
             "rng_state": self._rng.bit_generator.state,
             "normalisation_factor": self.normalisation_factor.tolist(),
         }
@@ -242,3 +245,11 @@ class PairedActivationBuffer:
         self._rng.bit_generator.state = state["rng_state"]
         self.first = True
         self.refresh()
+
+    def ensure_filled(self) -> None:
+        """Calibrate + fill a lazy buffer that a resume could not restore
+        (checkpoint without buffer state) — the from-scratch fallback, run
+        once, instead of crashing at the first ``next()``."""
+        if not self._filled:
+            self.normalisation_factor = self._estimate_norm_scaling_factors()
+            self.refresh()

@@ -38,7 +38,7 @@ from crosscoder_tpu.models import crosscoder as cc
 from crosscoder_tpu.parallel import mesh as mesh_lib
 from crosscoder_tpu.train import schedules
 from crosscoder_tpu.train.state import TrainState, init_train_state, make_optimizer
-from crosscoder_tpu.utils.logging import MetricsLogger
+from crosscoder_tpu.utils.logging import MetricsLogger, source_tag
 
 
 def make_train_step(
@@ -85,13 +85,11 @@ def expand_metrics(host_metrics: dict[str, Any], n_sources: int) -> dict[str, fl
     (``explained_variance_A``/``_B`` for the 2-model case, ``trainer.py:58-60``;
     indexed beyond that)."""
     out: dict[str, float] = {}
-    letters = "ABCDEFGH"
     for k, v in host_metrics.items():
         if k == "explained_variance_per_source":
             arr = np.asarray(v)
             for i in range(n_sources):
-                name = f"explained_variance_{letters[i]}" if i < len(letters) else f"explained_variance_{i}"
-                out[name] = float(arr[i])
+                out[f"explained_variance_{source_tag(i)}"] = float(arr[i])
         else:
             out[k] = float(v)
     return out
@@ -147,6 +145,11 @@ class Trainer:
         self.state = jax.device_put(state, self._state_shardings)
         if "buffer" in meta and hasattr(self.buffer, "load_state_dict"):
             self.buffer.load_state_dict(meta["buffer"])
+        elif hasattr(self.buffer, "ensure_filled"):
+            # checkpoint carries no buffer state (foreign/weights-only save):
+            # fall back to a fresh calibrate+fill now, not a crash mid-loop
+            print("[crosscoder_tpu] checkpoint has no buffer state; refilling fresh")
+            self.buffer.ensure_filled()
         return meta
 
     @property

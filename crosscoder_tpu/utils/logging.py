@@ -25,6 +25,16 @@ import time
 from pathlib import Path
 from typing import Any
 
+_LETTERS = "ABCDEFGH"
+
+
+def source_tag(i: int) -> str:
+    """Source index → metric-name suffix: A/B for the reference pair
+    (``explained_variance_A``/``_B``, reference trainer.py:58-60), letters
+    through H, then the bare index. Shared by the trainer metrics and the
+    CE eval so their key schemes cannot drift."""
+    return _LETTERS[i] if i < len(_LETTERS) else str(i)
+
 
 class MetricsLogger:
     def __init__(self, cfg) -> None:

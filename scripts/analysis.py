@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Decoder-space analysis entry (the reference's ``analysis.py`` as a real
+CLI): load a checkpoint, print the relative-norm cluster summary and
+shared-latent cosine stats, optionally write the histogram data and
+feature dashboards.
+
+    python scripts/analysis.py --version-dir checkpoints/version_0 \\
+        [--save N] [--out analysis_out]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+import numpy as np
+
+from crosscoder_tpu.analysis import (
+    cosine_sims,
+    relative_norms,
+    relative_norm_histogram,
+    shared_latent_mask,
+)
+from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--version-dir", required=True)
+    ap.add_argument("--save", type=int, default=None)
+    ap.add_argument("--out", type=str, default=None, help="dir for JSON outputs")
+    args = ap.parse_args(argv)
+
+    params, cfg = Checkpointer.load_weights(args.version_dir, args.save)
+    r = np.asarray(relative_norms(params))
+    shared = np.asarray(shared_latent_mask(params))
+    cos = np.asarray(cosine_sims(params))[shared]
+
+    summary = {
+        "d_hidden": int(r.shape[0]),
+        "cluster_A_only": int((r <= 0.3).sum()),      # analysis.py:35 band edges
+        "cluster_shared": int(shared.sum()),
+        "cluster_B_only": int((r >= 0.7).sum()),
+        "shared_cosine_median": float(np.median(cos)) if cos.size else None,
+        "shared_cosine_frac_gt_0.95": float((cos > 0.95).mean()) if cos.size else None,
+    }
+    print(json.dumps(summary, indent=2))
+
+    if args.out:
+        out = Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        counts, edges = relative_norm_histogram(params)
+        (out / "relative_norm_hist.json").write_text(json.dumps({
+            "counts": np.asarray(counts).tolist(),
+            "edges": np.asarray(edges).tolist(),
+        }))
+        (out / "summary.json").write_text(json.dumps(summary, indent=2))
+        print(f"wrote {out}/relative_norm_hist.json")
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Tests for the plot helpers (reference utils.py:45-147) and the
+sae_vis-equivalent feature dashboards (reference nb:cells 33-42)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from crosscoder_tpu.analysis.dashboards import FeatureVisConfig, FeatureVisData
+from crosscoder_tpu.analysis.plots import (
+    svg_histogram,
+    tokens_to_html,
+)
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.models import lm
+
+HP = "blocks.2.hook_resid_pre"
+
+
+def test_tokens_to_html_escapes_and_colors():
+    html = tokens_to_html(["<b>", "safe", "nl\n"], [0.0, 1.0, 0.5])
+    assert "&lt;b&gt;" in html                     # escaped
+    assert "↵" in html                             # visible newline
+    assert 'title="1.000"' in html                 # hover value
+    assert html.count("<span") == 3
+
+
+def test_svg_histogram_counts():
+    svg = svg_histogram([0.1] * 5 + [0.9] * 3, bins=2, width=100, height=50)
+    assert svg.count("<rect") == 2
+    assert ": 5</title>" in svg and ": 3</title>" in svg
+
+
+@pytest.fixture(scope="module")
+def dash_setup():
+    lm_cfg = lm.LMConfig.tiny()
+    params = [lm.init_params(jax.random.key(i), lm_cfg) for i in range(2)]
+    cfg = CrossCoderConfig(d_in=32, dict_size=64, batch_size=16, enc_dtype="fp32")
+    cc_params = cc.init_params(jax.random.key(9), cfg)
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 257, size=(12, 24), dtype=np.int64)
+    return lm_cfg, params, cfg, cc_params, tokens
+
+
+def test_feature_vis_data(dash_setup):
+    lm_cfg, params, cfg, cc_params, tokens = dash_setup
+    vis_cfg = FeatureVisConfig(hook_point=HP, features=(0, 5, 63),
+                               minibatch_size_tokens=4, top_k_sequences=3)
+    data = FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_cfg)
+    assert [f.feature for f in data.features] == [0, 5, 63]
+    for fd in data.features:
+        assert 0.0 <= fd.frac_active <= 1.0
+        assert 0.0 <= fd.relative_norm <= 1.0
+        assert len(fd.top_seqs) <= 3
+        for seq in fd.top_seqs:
+            assert len(seq["tokens"]) == len(seq["values"])
+            # peak token is the displayed window's argmax
+            assert seq["values"][seq["peak"]] == max(seq["values"])
+
+
+def test_feature_acts_match_direct_encode(dash_setup):
+    """Dashboard latent activations == direct harvest→encode path."""
+    lm_cfg, params, cfg, cc_params, tokens = dash_setup
+    vis_cfg = FeatureVisConfig(hook_point=HP, features=(5,),
+                               minibatch_size_tokens=12)
+    data = FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_cfg)
+    caches = [lm.run_with_cache(p, jnp.asarray(tokens), lm_cfg, [HP])[HP] for p in params]
+    x = jnp.stack(caches, axis=2)[:, 1:].astype(jnp.float32)
+    f = np.asarray(cc.encode(cc_params, x, cfg))[..., 5]
+    assert data.features[0].max_act == pytest.approx(float(f.max()), rel=1e-5)
+    assert data.features[0].frac_active == pytest.approx(float((f > 0).mean()), abs=1e-9)
+
+
+def test_save_feature_centric_vis(dash_setup, tmp_path):
+    lm_cfg, params, cfg, cc_params, tokens = dash_setup
+    vis_cfg = FeatureVisConfig(hook_point=HP, features=(0, 1))
+    data = FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_cfg)
+    out = data.save_feature_centric_vis(tmp_path / "vis.html")
+    doc = out.read_text()
+    assert doc.startswith("<!doctype html>")
+    assert "feature 0" in doc and "feature 1" in doc
+    assert HP in doc
+    # custom tokenizer hook
+    out2 = data.save_feature_centric_vis(tmp_path / "vis2.html", decode_fn=lambda t: f"T{t}")
+    assert "T" + str(int(tokens[0, 1])) in out2.read_text() or "T" in out2.read_text()
+
+
+def test_analysis_script_end_to_end(tmp_path):
+    """scripts/analysis.py on a saved checkpoint prints the 3-cluster
+    summary (reference analysis.py flow)."""
+    import sys
+    sys.path.insert(0, "scripts")
+    try:
+        import analysis as analysis_script
+    finally:
+        sys.path.pop(0)
+    from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train import schedules
+
+    cfg = CrossCoderConfig(d_in=16, dict_size=64, checkpoint_dir=str(tmp_path))
+    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    state = init_train_state(jax.random.key(0), cfg, tx)
+    ckpt = Checkpointer(cfg=cfg)
+    ckpt.save(state, cfg)
+    vdir = Checkpointer.latest_version_dir(tmp_path)
+    summary = analysis_script.main(["--version-dir", str(vdir), "--out", str(tmp_path / "o")])
+    assert summary["d_hidden"] == 64
+    total = summary["cluster_A_only"] + summary["cluster_shared"] + summary["cluster_B_only"]
+    assert total == 64
+    assert (tmp_path / "o" / "relative_norm_hist.json").exists()
