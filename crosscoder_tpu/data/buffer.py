@@ -102,7 +102,11 @@ class PairedActivationBuffer:
         self._perm = np.arange(self.buffer_size)
         self._rng = np.random.default_rng(cfg.seed)
         self.pointer = 0            # read position in the permutation
-        self.token_pointer = 0      # next unharvested sequence
+        self.token_pointer = 0      # next unharvested sequence (mod corpus)
+        self._global_seq = 0        # monotone count of harvested sequences
+        # per-row provenance: which global sequence produced each store row —
+        # lets save/resume rewind to the OLDEST unserved row's tokens
+        self._src_global = np.zeros(self.buffer_size, dtype=np.int64)
         self.first = True
         self._filled = False
 
@@ -182,11 +186,15 @@ class PairedActivationBuffer:
         write = 0
         for start in range(0, num_batches, self._chunk_seqs):
             stop = min(start + self._chunk_seqs, num_batches)
-            chunk = self._take_tokens(stop - start)
+            n_seqs = stop - start
+            seq_globals = self._global_seq + np.arange(n_seqs)
+            chunk = self._take_tokens(n_seqs)
             acts = self._harvest(chunk)                     # [B, S, n, d]
             acts = acts[:, 1:]                              # drop BOS (buffer.py:93)
             rows = acts.reshape(-1, cfg.n_sources, cfg.d_in)
-            self._store[self._perm[write: write + rows.shape[0]]] = rows
+            positions = self._perm[write: write + rows.shape[0]]
+            self._store[positions] = rows
+            self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
             write += rows.shape[0]
         assert write == num_batches * rows_per_seq
         self._perm = self._rng.permutation(self.buffer_size)
@@ -200,6 +208,7 @@ class PairedActivationBuffer:
         total = self.tokens.shape[0]
         idx = (self.token_pointer + np.arange(n)) % total
         self.token_pointer = (self.token_pointer + n) % total
+        self._global_seq += n
         return self.tokens[idx]
 
     # ------------------------------------------------------------------
@@ -226,23 +235,34 @@ class PairedActivationBuffer:
 
     def state_dict(self) -> dict[str, Any]:
         """Stream-resume state. The ~5 GB store is NOT saved; on restore the
-        buffer re-fills starting from the saved token pointer REWOUND by the
-        sequences whose rows were harvested but not yet served, so no token's
-        activations are dropped unseen by a save/resume cycle (some
-        already-served tokens near the save point are re-harvested instead —
-        the safe direction for training data)."""
-        rows_per_seq = self.cfg.seq_len - 1
-        unserved_seqs = -(-(self.buffer_size - self.pointer) // rows_per_seq)
+        buffer re-fills starting from the OLDEST unserved row's source
+        sequence (per-row provenance in ``_src_global``), so no token's
+        activations are dropped unseen by a save/resume cycle — tokens
+        between that oldest straggler and the save point are re-harvested
+        (and some re-served), the safe direction for training data. A save
+        before the first fill (crash during startup) records a from-scratch
+        state."""
+        if not self._filled:
+            return {"token_pointer": 0, "rng_state": self._rng.bit_generator.state,
+                    "normalisation_factor": None}
+        unserved = self._perm[self.pointer:]
+        oldest = int(self._src_global[unserved].min()) if unserved.size else self._global_seq
         return {
-            "token_pointer": int((self.token_pointer - unserved_seqs) % self.tokens.shape[0]),
+            "token_pointer": oldest % self.tokens.shape[0],
             "rng_state": self._rng.bit_generator.state,
             "normalisation_factor": self.normalisation_factor.tolist(),
         }
 
     def load_state_dict(self, state: dict[str, Any]) -> None:
-        self.normalisation_factor = np.asarray(state["normalisation_factor"], np.float32)
         self.token_pointer = int(state["token_pointer"])
+        self._global_seq = self.token_pointer
         self._rng.bit_generator.state = state["rng_state"]
+        if state.get("normalisation_factor") is None:
+            self.first = True
+            self._filled = False
+            self.ensure_filled()        # calibrate + fill from scratch
+            return
+        self.normalisation_factor = np.asarray(state["normalisation_factor"], np.float32)
         self.first = True
         self.refresh()
 

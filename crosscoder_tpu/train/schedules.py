@@ -52,3 +52,24 @@ def l1_coeff_schedule(cfg: CrossCoderConfig) -> Schedule:
         return cfg.l1_coeff * jnp.minimum(1.0, step / warmup)
 
     return f
+
+
+# --- scalar (host/torch-backend) variants of the same schedules ---------
+
+
+def lr_lambda(step: int, cfg: CrossCoderConfig) -> float:
+    """Multiplier form of :func:`lr_schedule` (reference ``trainer.py:28-32``
+    feeds exactly this into ``LambdaLR``)."""
+    total = cfg.total_steps
+    decay_start = (1.0 - cfg.lr_decay_frac) * total
+    if step < decay_start:
+        return 1.0
+    return max(0.0, 1.0 - (step - decay_start) / (total - decay_start))
+
+
+def l1_coeff_at(step: int, cfg: CrossCoderConfig) -> float:
+    """Scalar :func:`l1_coeff_schedule` (reference ``trainer.py:34-39``)."""
+    warmup = cfg.l1_warmup_frac * cfg.total_steps
+    if warmup <= 0:
+        return cfg.l1_coeff
+    return cfg.l1_coeff * min(1.0, step / warmup)

@@ -37,6 +37,10 @@ def make_optimizer(cfg: CrossCoderConfig, lr_fn) -> optax.GradientTransformation
 
 
 def init_train_state(key: jax.Array, cfg: CrossCoderConfig, tx: optax.GradientTransformation) -> TrainState:
-    # fp32 master weights; the loss casts to cfg.enc_dtype for MXU compute
-    params = cc.init_params(key, cfg, dtype=jnp.float32)
+    # master weights in cfg.master_dtype — fp32 (default, a quality upgrade)
+    # or bf16 (exact reference parity: its params and Adam moments are all
+    # bf16, and ~2x less optimizer HBM traffic); the loss casts to
+    # cfg.enc_dtype for MXU compute either way
+    dtype = jnp.float32 if cfg.master_dtype == "fp32" else jnp.bfloat16
+    params = cc.init_params(key, cfg, dtype=dtype)
     return TrainState(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))

@@ -1,0 +1,155 @@
+"""Torch execution backend for the crosscoder train step (component N7).
+
+The north star calls for a pluggable backend boundary — "torch vs. jax, so
+train.py is unchanged" (BASELINE.json) — and this is the torch side: the
+same step semantics as :mod:`crosscoder_tpu.train.trainer` (reference
+``trainer.py:41-49``: loss = l2 + l1_coeff(t)·l1, global-norm clip 1.0,
+Adam, LR/L1 schedules) executed by torch on CPU/GPU. It exists for
+
+- **parity**: an independent engine running the identical config lets tests
+  assert the JAX step reproduces the reference's training trajectory,
+- **benchmarking**: the measured torch throughput is the denominator of the
+  8×-per-chip target (BASELINE.md: the reference publishes none).
+
+Select it via ``backend="torch"`` on :func:`make_trainer`; the host loop,
+logging, checkpoint layout, and data sources are shared — only the step
+engine changes, which is exactly the reference's ``train.py`` boundary.
+
+This backend is NOT the TPU path (torch here is CPU-only by design — the
+image ships no CUDA torch); it deliberately mirrors the reference's eager
+structure rather than re-optimizing it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.train.schedules import lr_lambda, l1_coeff_at
+from crosscoder_tpu.utils.logging import MetricsLogger, source_tag
+
+
+class TorchTrainer:
+    """Host loop + torch step with the reference's exact semantics."""
+
+    def __init__(
+        self,
+        cfg: CrossCoderConfig,
+        buffer: Any | None = None,
+        logger: MetricsLogger | None = None,
+        device: str = "cpu",
+    ) -> None:
+        import torch
+
+        if cfg.activation != "relu":
+            raise NotImplementedError(
+                f"torch backend implements the reference's dense-ReLU step only; "
+                f"activation={cfg.activation!r} must use the jax backend"
+            )
+        self.torch = torch
+        self.cfg = cfg
+        self.device = device
+        if buffer is None:
+            from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+
+            buffer = SyntheticActivationSource(cfg)
+        self.buffer = buffer
+        self.logger = logger
+        self.total_steps = cfg.total_steps
+        self.step_counter = 0
+
+        # init matches cc.init_params (reference crosscoder.py:33-62): W_dec
+        # rows at dec_init_norm, W_enc = W_decᵀ, zero biases
+        g = torch.Generator().manual_seed(cfg.seed)
+        n, d, h = cfg.n_sources, cfg.d_in, cfg.dict_size
+        w = torch.randn(h, n, d, generator=g)
+        w = w / w.norm(dim=-1, keepdim=True) * cfg.dec_init_norm
+        self.params = {
+            "W_dec": w.clone().to(device).requires_grad_(True),
+            "W_enc": w.permute(1, 2, 0).clone().to(device).requires_grad_(True),
+            "b_enc": torch.zeros(h, device=device, requires_grad=True),
+            "b_dec": torch.zeros(n, d, device=device, requires_grad=True),
+        }
+        self.opt = torch.optim.Adam(
+            list(self.params.values()), lr=cfg.lr, betas=(cfg.beta1, cfg.beta2)
+        )
+        self.sched = torch.optim.lr_scheduler.LambdaLR(
+            self.opt, lambda s: lr_lambda(s, cfg)
+        )
+
+    def losses(self, x):
+        """Reference crosscoder.py:96-130 in torch (fp32)."""
+        torch = self.torch
+        p = self.params
+        f = torch.relu(torch.einsum("bnd,ndh->bh", x, p["W_enc"]) + p["b_enc"])
+        recon = torch.einsum("bh,hnd->bnd", f, p["W_dec"]) + p["b_dec"]
+        err2 = (recon - x) ** 2
+        per_row = err2.sum(dim=(1, 2))
+        l2 = per_row.mean()
+        dec_norm_total = p["W_dec"].norm(dim=-1).sum(dim=-1)
+        l1 = (f * dec_norm_total[None]).sum(-1).mean()
+        l0 = (f > 0).float().sum(-1).mean()
+        eps = 1e-8
+        ctr = x - x.mean(0)
+        ev = 1 - per_row / ((ctr**2).sum(dim=(1, 2)) + eps)
+        ev_src = 1 - err2.sum(-1) / ((ctr**2).sum(-1) + eps)   # [B, n]
+        return {"l2_loss": l2, "l1_loss": l1, "l0_loss": l0,
+                "explained_variance": ev.mean(),
+                "ev_per_source": ev_src.mean(0)}
+
+    def step(self) -> dict[str, float]:
+        torch = self.torch
+        x = torch.as_tensor(
+            np.asarray(self.buffer.next(), dtype=np.float32), device=self.device
+        )
+        losses = self.losses(x)
+        l1c = l1_coeff_at(self.step_counter, self.cfg)
+        loss = losses["l2_loss"] + l1c * losses["l1_loss"]
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(list(self.params.values()), max_norm=self.cfg.grad_clip)
+        # read the lr BEFORE sched.step(): this is λ(step)·lr, the value
+        # opt.step() just applied and what the jax trainer logs
+        lr_applied = float(self.sched.get_last_lr()[0])
+        self.opt.step()
+        self.sched.step()
+        self.opt.zero_grad()
+        out = {
+            "loss": float(loss),
+            "l2_loss": float(losses["l2_loss"]),
+            "l1_loss": float(losses["l1_loss"]),
+            "l0_loss": float(losses["l0_loss"]),
+            "l1_coeff": float(l1c),
+            "lr": lr_applied,
+            "explained_variance": float(losses["explained_variance"]),
+        }
+        for i, v in enumerate(losses["ev_per_source"]):
+            out[f"explained_variance_{source_tag(i)}"] = float(v)
+        self.step_counter += 1
+        return out
+
+    def train(self, num_steps: int | None = None) -> dict[str, float]:
+        num_steps = self.total_steps if num_steps is None else num_steps
+        metrics: dict[str, float] = {}
+        for i in range(self.step_counter, num_steps):
+            metrics = self.step()
+            if self.logger is not None and i % self.cfg.log_every == 0:
+                self.logger.log(metrics, step=i)
+        return metrics
+
+    def numpy_params(self) -> dict[str, np.ndarray]:
+        return {k: v.detach().cpu().numpy() for k, v in self.params.items()}
+
+
+def make_trainer(cfg: CrossCoderConfig, backend: str = "jax", **kwargs: Any):
+    """The backend boundary: identical call surface, engine chosen by name
+    (BASELINE.json north star: "pluggable backend ... so train.py is
+    unchanged")."""
+    if backend == "jax":
+        from crosscoder_tpu.train.trainer import Trainer
+
+        return Trainer(cfg, **kwargs)
+    if backend == "torch":
+        return TorchTrainer(cfg, **kwargs)
+    raise ValueError(f"unknown backend {backend!r}; expected 'jax' or 'torch'")

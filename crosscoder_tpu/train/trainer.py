@@ -173,19 +173,45 @@ class Trainer:
 
     def train(self, num_steps: int | None = None) -> dict[str, float]:
         """Run the training loop (reference ``trainer.py:72-82`` semantics:
-        periodic log/save, final save in ``finally``)."""
+        periodic log/save, final save in ``finally``).
+
+        Observability the reference lacks (SURVEY.md §5 tracing): wall-clock
+        ``step_time_ms`` (mean between logs, device-synced only at log
+        points) rides along with every log record, and a non-empty
+        ``cfg.profile_dir`` captures a ``jax.profiler`` device trace of
+        steps 10-14 for tensorboard/xprof."""
+        import time
+
         num_steps = self.total_steps if num_steps is None else num_steps
         metrics: dict[str, Any] = {}
         start = self.step_counter  # nonzero after restore()
         progress = _progress_bar(start, num_steps)
+        profiling = False
+        last_log_t, last_log_i = time.perf_counter(), start
         try:
             for i in progress:
+                if self.cfg.profile_dir and i == start + 10:
+                    jax.profiler.start_trace(self.cfg.profile_dir)
+                    profiling = True
                 metrics = self.step()
+                if profiling and i >= start + 14:
+                    float(jax.device_get(metrics["loss"]))
+                    jax.profiler.stop_trace()
+                    profiling = False
                 if i % self.cfg.log_every == 0:
+                    # sync via a scalar fetch: block_until_ready is not an
+                    # execution barrier under remote-tunnel TPU clients
+                    float(jax.device_get(metrics["loss"]))
+                    now = time.perf_counter()
+                    metrics = dict(metrics)
+                    metrics["step_time_ms"] = 1000 * (now - last_log_t) / max(i - last_log_i, 1)
+                    last_log_t, last_log_i = now, i
                     self.log(metrics, step=i)
                 if (i + 1) % self.cfg.save_every == 0:
                     self.save()
         finally:
+            if profiling:
+                jax.profiler.stop_trace()
             self.save()
             if self.logger is not None:
                 self.logger.close()

@@ -219,3 +219,31 @@ def test_rejects_mismatched_models(lm_pair, tokens):
     lm_cfg, params = lm_pair
     with pytest.raises(ValueError):
         PairedActivationBuffer(make_cfg(n_models=3), lm_cfg, params, tokens)
+
+
+def test_resume_rewinds_to_oldest_unserved_row(lm_pair, tokens):
+    """Per-row provenance: the saved token pointer equals the OLDEST
+    unserved row's source sequence, so no harvested-but-unserved token is
+    skipped by save/resume (mid-fill save, survivors from the first fill
+    still present)."""
+    lm_cfg, params = lm_pair
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    for _ in range(20):                          # crosses one refresh
+        b.next()
+    assert b.pointer > 0
+    state = b.state_dict()
+    oldest = int(b._src_global[b._perm[b.pointer:]].min())
+    assert state["token_pointer"] == oldest % 256
+    # survivors of the first fill are unserved ⇒ rewind reaches back into it
+    assert oldest < 64
+
+
+def test_save_before_first_fill_resumes_from_scratch(lm_pair, tokens):
+    lm_cfg, params = lm_pair
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens, lazy=True)
+    state = b.state_dict()                       # crash-during-startup save
+    assert state["normalisation_factor"] is None
+    b2 = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens, lazy=True)
+    b2.load_state_dict(state)
+    assert b2._filled and b2.token_pointer == 64
+    assert b2.next().shape == (32, 2, 32)
