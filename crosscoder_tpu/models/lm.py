@@ -196,6 +196,21 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     ).astype(x.dtype)
 
 
+def _qkv(
+    x: jax.Array, lp: Mapping[str, jax.Array], cfg: LMConfig, pos: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Project + RoPE: q [B,S,H,hd], k/v [B,S,KV,hd]. ``pos`` carries GLOBAL
+    positions so sequence-sharded callers rotate correctly."""
+    B, S, _ = x.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q = jnp.einsum("bsd,dq->bsq", x, lp["wq"], preferred_element_type=jnp.float32)
+    k = jnp.einsum("bsd,dk->bsk", x, lp["wk"], preferred_element_type=jnp.float32)
+    v = jnp.einsum("bsd,dk->bsk", x, lp["wv"], preferred_element_type=jnp.float32)
+    q = _rope(q.astype(x.dtype).reshape(B, S, H, hd), pos, cfg.rope_theta)
+    k = _rope(k.astype(x.dtype).reshape(B, S, KV, hd), pos, cfg.rope_theta)
+    return q, k, v.astype(x.dtype).reshape(B, S, KV, hd)
+
+
 def _attention(
     x: jax.Array, lp: Mapping[str, jax.Array], cfg: LMConfig, is_local: jax.Array
 ) -> jax.Array:
@@ -204,13 +219,7 @@ def _attention(
     B, S, D = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     pos = jnp.arange(S)
-
-    q = jnp.einsum("bsd,dq->bsq", x, lp["wq"], preferred_element_type=jnp.float32)
-    k = jnp.einsum("bsd,dk->bsk", x, lp["wk"], preferred_element_type=jnp.float32)
-    v = jnp.einsum("bsd,dk->bsk", x, lp["wv"], preferred_element_type=jnp.float32)
-    q = _rope(q.astype(x.dtype).reshape(B, S, H, hd), pos, cfg.rope_theta)
-    k = _rope(k.astype(x.dtype).reshape(B, S, KV, hd), pos, cfg.rope_theta)
-    v = v.astype(x.dtype).reshape(B, S, KV, hd)
+    q, k, v = _qkv(x, lp, cfg, pos)
 
     # GQA: fold the group axis into the query head axis instead of repeating
     # K/V (saves HBM traffic; XLA contracts over the shared kv head axis).
@@ -282,6 +291,24 @@ class Edit:
     value: jax.Array | None = None
 
 
+def _capture_into(buf: jax.Array | None, resid: jax.Array, i, cap_arr) -> jax.Array | None:
+    """Accumulate ``resid`` into the capture slot whose layer equals ``i``
+    (one-hot over slots; shared by the dense and sequence-parallel paths)."""
+    if buf is None:
+        return None
+    match = (cap_arr == i).astype(resid.dtype)
+    return buf + match[:, None, None, None] * resid[None]
+
+
+def _unembed(params: LMParams, resid: jax.Array, cfg: LMConfig) -> jax.Array:
+    """Final RMSNorm → tied unembedding → final-logit softcap."""
+    x = _rms_norm(resid, params["final_norm"], cfg.rms_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32)
+    if cfg.final_softcap:
+        logits = _softcap(logits, cfg.final_softcap)
+    return logits
+
+
 def _hook_layers(cfg: LMConfig, hook_points: Sequence[str]) -> tuple[int, ...]:
     """Map hook strings to capture layer indices. ``resid_pre`` of layer L is
     the stream entering block L; ``resid_post`` of L is ``resid_pre`` of L+1
@@ -336,12 +363,6 @@ def _forward_impl(
             resid = jnp.where(edit_arr[j] == i, edited, resid)
         return resid
 
-    def capture_at(buf, resid, i):
-        if n_cap == 0:
-            return buf
-        match = (cap_arr == i).astype(dt)                   # one-hot over slots
-        return buf + match[:, None, None, None] * resid[None]
-
     stacked = params["layers"]
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
 
@@ -349,7 +370,7 @@ def _forward_impl(
         resid, buf = carry
         lp, i = xs
         resid = apply_hooks(resid, i)
-        buf = capture_at(buf, resid, i)
+        buf = _capture_into(buf, resid, i, cap_arr)
         is_local = (i % 2) == 0                             # even layers: sliding window
         resid = _block(resid, lp, cfg, is_local)
         return (resid, buf), None
@@ -357,14 +378,9 @@ def _forward_impl(
     (resid, cap_buf), _ = jax.lax.scan(body, (resid, cap_buf), (stacked, layer_ids))
     # virtual layer n_layers = final resid_post
     resid = apply_hooks(resid, jnp.int32(cfg.n_layers))
-    cap_buf = capture_at(cap_buf, resid, jnp.int32(cfg.n_layers))
+    cap_buf = _capture_into(cap_buf, resid, jnp.int32(cfg.n_layers), cap_arr)
 
-    logits = None
-    if return_logits:
-        x = _rms_norm(resid, params["final_norm"], cfg.rms_eps)
-        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"], preferred_element_type=jnp.float32)
-        if cfg.final_softcap:
-            logits = _softcap(logits, cfg.final_softcap)
+    logits = _unembed(params, resid, cfg) if return_logits else None
     return logits, cap_buf
 
 
@@ -429,6 +445,110 @@ def ce_loss(
     """CE of a (possibly intervened) forward — one number, on device."""
     logits, _ = forward(params, tokens, cfg, edits=edits)
     return loss_fn(logits, tokens)
+
+
+# ---------------------------------------------------------------------------
+# sequence-parallel forward (long-context harvest; SURVEY component N5)
+
+
+def forward_seq_parallel(
+    params: LMParams,
+    tokens: jax.Array,
+    cfg: LMConfig,
+    mesh,
+    *,
+    axis_name: str = "data",
+    capture: Sequence[str] = (),
+    return_logits: bool = False,
+) -> tuple[jax.Array | None, dict[str, jax.Array]]:
+    """Gemma-2 forward with the SEQUENCE axis sharded over a mesh axis.
+
+    The context-length analogue of :func:`forward`: the per-device score
+    matrix shrinks by n², so contexts far beyond one chip's HBM harvest
+    fine — attention runs as an exact ring (K/V blocks rotate over ICI via
+    ``ppermute``; :mod:`crosscoder_tpu.parallel.ring_attention`), every
+    other op is position-local. Params are replicated; ``tokens [B, S]``
+    must have S divisible by the axis size. Capture semantics match
+    :func:`forward` (cache values come back as globally-stitched arrays);
+    activation *edits* are a short-context eval feature and are not
+    supported here.
+
+    Numerics are asserted equal to the dense forward by
+    ``tests/test_ring_attention.py``.
+    """
+    n = mesh.shape[axis_name]
+    S = tokens.shape[1]
+    if S % n != 0:
+        raise ValueError(f"seq len {S} not divisible by {n} sequence shards")
+    cap_layers = _hook_layers(cfg, tuple(capture))
+    fn = _seq_parallel_fn(cfg, mesh, axis_name, cap_layers, return_logits)
+    logits, cap_buf = fn(params, tokens)
+    cache = {hp: cap_buf[i] for i, hp in enumerate(capture)}
+    return logits, cache
+
+
+@functools.lru_cache(maxsize=32)
+def _seq_parallel_fn(
+    cfg: LMConfig, mesh, axis_name: str, cap_layers: tuple[int, ...], return_logits: bool
+):
+    """Compile-once builder for the sequence-parallel forward (keyed on
+    everything that changes the traced program; token/batch shapes go
+    through the inner jit's normal shape-keyed cache)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from crosscoder_tpu.parallel.ring_attention import ring_attention
+
+    n = mesh.shape[axis_name]
+    dt = dtype_of(cfg.dtype)
+    n_cap = len(cap_layers)
+    scale = cfg.query_pre_attn_scalar ** -0.5
+
+    def local_fn(params, tok_local):
+        B, Sl = tok_local.shape
+        cap_arr = jnp.asarray(cap_layers, jnp.int32) if n_cap else None
+        idx = jax.lax.axis_index(axis_name)
+        pos = idx * Sl + jnp.arange(Sl)
+        resid = params["embed"][tok_local].astype(dt) * jnp.asarray(
+            math.sqrt(cfg.d_model), dt
+        )
+        buf = jnp.zeros((n_cap, B, Sl, cfg.d_model), dt) if n_cap else None
+
+        def body(carry, xs):
+            resid, buf = carry
+            lp, i = xs
+            buf = _capture_into(buf, resid, i, cap_arr)
+            is_local = (i % 2) == 0
+            xn = _rms_norm(resid, lp["attn_norm"], cfg.rms_eps)
+            q, k, v = _qkv(xn, lp, cfg, pos)
+            a = ring_attention(
+                q, k, v, axis_name=axis_name, n_shards=n, scale=scale,
+                softcap=cfg.attn_softcap, sliding_window=cfg.sliding_window,
+                is_local=is_local,
+            ).reshape(B, Sl, cfg.n_heads * cfg.head_dim)
+            a = jnp.einsum(
+                "bsq,qd->bsd", a, lp["wo"], preferred_element_type=jnp.float32
+            ).astype(dt)
+            resid = resid + _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+            mlp = _mlp(_rms_norm(resid, lp["pre_ffw_norm"], cfg.rms_eps), lp)
+            resid = resid + _rms_norm(mlp, lp["post_ffw_norm"], cfg.rms_eps)
+            return (resid, buf), None
+
+        layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+        (resid, buf), _ = jax.lax.scan(body, (resid, buf), (params["layers"], layer_ids))
+        buf = _capture_into(buf, resid, jnp.int32(cfg.n_layers), cap_arr)
+        logits = _unembed(params, resid, cfg) if return_logits else None
+        return logits, buf
+
+    out_logits_spec = P(None, axis_name, None) if return_logits else P()
+    out_cap_spec = P(None, None, axis_name, None) if n_cap else P()
+    return jax.jit(shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name)),
+        out_specs=(out_logits_spec, out_cap_spec),
+        check_vma=False,
+    ))
 
 
 # ---------------------------------------------------------------------------

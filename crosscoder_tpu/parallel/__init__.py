@@ -14,3 +14,5 @@ from crosscoder_tpu.parallel.mesh import (  # noqa: F401
     param_shardings,
     state_shardings,
 )
+from crosscoder_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+from crosscoder_tpu.parallel import multihost  # noqa: F401

@@ -1,0 +1,72 @@
+"""Multi-host (multi-process) initialization for pod-scale training.
+
+The reference is a single-process, single-GPU program with no distributed
+backend at all (SURVEY.md §5 "Distributed communication backend: absent").
+Here the backend IS XLA: once ``jax.distributed.initialize`` has run on
+every host, ``jax.devices()`` spans the whole slice/pod, the same
+``Mesh``-building code in :mod:`crosscoder_tpu.parallel.mesh` lays axes
+over all of it, and every collective in the framework (grad psums, the TP
+loss reductions, ring-attention ppermutes) rides ICI within a slice and
+DCN across slices exactly as compiled — no framework code changes between
+1 chip and a pod.
+
+Usage on each host of a pod slice (TPU VMs auto-discover coordinates, so
+bare ``initialize()`` suffices there):
+
+    from crosscoder_tpu.parallel import multihost
+    multihost.initialize()          # no-op off-pod / single-process
+    mesh = mesh_lib.make_mesh(...)  # now spans all hosts' devices
+
+Host-side work splits by :func:`is_primary` (checkpoint writes, metric
+logging, the buffer's token stream ownership); device-side work needs no
+gating — pjit/shard_map programs are SPMD across processes by construction.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-process runtime; returns True when distributed.
+
+    Activation is EXPLICIT: a ``coordinator_address`` argument, the
+    ``JAX_COORDINATOR_ADDRESS`` env var, or ``CROSSCODER_MULTIHOST=1``
+    (which lets ``jax.distributed.initialize`` auto-discover pod
+    coordinates on TPU VMs). Anything else is a no-op, so the same entry
+    point runs on a laptop, one chip, or a pod — and single-host TPU
+    environments that happen to export pod-looking variables (e.g.
+    ``TPU_WORKER_HOSTNAMES=localhost``) are not misdetected. Must be
+    called before the first JAX computation of the process.
+    """
+    explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    opted_in = os.environ.get("CROSSCODER_MULTIHOST") == "1"
+    if not explicit and not opted_in:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=explicit,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def is_primary() -> bool:
+    """True on the process that owns host-side singletons (checkpoint
+    writes, wandb/jsonl logging, progress bars)."""
+    return jax.process_index() == 0
+
+
+def process_info() -> dict[str, int]:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }

@@ -1,0 +1,106 @@
+"""Ring attention: exact attention over a sequence sharded across devices.
+
+Long-context harvesting support (SURVEY.md component N5): the reference's
+TransformerLens forward is single-device and caps context by one chip's HBM
+(attention scores are O(S²)); here the sequence axis shards over a mesh
+axis and attention runs as a **ring** — each device holds one Q/K/V block,
+computes attention against the K/V block it currently holds, then passes
+that K/V block to its neighbor with ``jax.lax.ppermute`` (one ICI hop per
+step, n_shards steps, compute overlapping communication under XLA's
+scheduler). The per-block softmax is combined with the standard online
+(log-sum-exp running max) accumulation, so the result is EXACTLY full
+attention — not an approximation — while no device ever materializes more
+than S·S/n² of the score matrix.
+
+Implements the Gemma-2 attention semantics of
+:func:`crosscoder_tpu.models.lm._attention` (GQA with the group axis folded
+into queries, logit softcapping, causal + alternating sliding-window masks)
+so the sequence-parallel forward is numerically interchangeable with the
+dense one — ``tests/test_ring_attention.py`` asserts parity on an 8-way
+mesh.
+
+This file is deliberately collective-based (ppermute), not a Pallas kernel:
+the per-block math is MXU einsums XLA already schedules well, and the
+transport is ICI where XLA's collective lowering is the optimized path
+(guide: "Patterns: Ring Collectives" is for when compute must interleave
+with RDMA *inside* a kernel, which bf16 block attention does not need).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30  # mask value; kept finite so fully-masked blocks stay NaN-free
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    n_shards: int,
+    scale: float,
+    softcap: float = 0.0,
+    sliding_window: int = 0,
+    is_local: jax.Array | bool = False,
+) -> jax.Array:
+    """Exact causal attention over a ring of sequence shards.
+
+    Must be called inside ``shard_map`` over ``axis_name``. Per device:
+    ``q [B, Sq, H, hd]``, ``k/v [B, Sk, KV, hd]`` — the local blocks of a
+    globally ``n_shards×`` longer sequence, device i holding positions
+    ``[i·S, (i+1)·S)``. ``is_local`` selects the sliding-window mask
+    (traced, so one compiled fn serves Gemma-2's alternating layers).
+    Returns the local output block ``[B, Sq, H, hd]``.
+    """
+    B, Sq, H, hd = q.shape
+    Sk, KV = k.shape[1], k.shape[2]
+    g = H // KV
+    idx = jax.lax.axis_index(axis_name)
+
+    qg = q.reshape(B, Sq, KV, g, hd).astype(jnp.float32) * scale
+    q_pos = idx * Sq + jnp.arange(Sq)
+
+    m = jnp.full((B, KV, g, Sq), _NEG, jnp.float32)
+    l = jnp.zeros((B, KV, g, Sq), jnp.float32)
+    o = jnp.zeros((B, KV, g, Sq, hd), jnp.float32)
+
+    for step in range(n_shards):
+        owner = (idx - step) % n_shards         # whose block we hold now
+        k_pos = owner * Sk + jnp.arange(Sk)
+
+        logits = jnp.einsum(
+            "bqkgh,bskh->bkgqs", qg.astype(q.dtype), k,
+            preferred_element_type=jnp.float32,
+        )
+        if softcap:
+            logits = softcap * jnp.tanh(logits / softcap)
+
+        causal = q_pos[:, None] >= k_pos[None, :]           # [Sq, Sk]
+        window = q_pos[:, None] - k_pos[None, :] < sliding_window
+        mask = jnp.where(jnp.asarray(is_local), causal & window, causal)
+        mask4 = mask[None, None, None]                       # [1,1,1,Sq,Sk]
+        logits = jnp.where(mask4, logits, _NEG)
+
+        blk_m = jnp.max(logits, axis=-1)                     # [B,KV,g,Sq]
+        new_m = jnp.maximum(m, blk_m)
+        # p is explicitly re-masked: a fully-masked block has logits == _NEG
+        # == new_m and would otherwise contribute exp(0)=1 per entry
+        p = jnp.exp(logits - new_m[..., None]) * mask4
+        corr = jnp.exp(m - new_m)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bkgqs,bskh->bkgqh", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        m = new_m
+
+        if step < n_shards - 1:
+            perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(B, Sq, H, hd).astype(q.dtype)

@@ -58,12 +58,20 @@ def build_buffer(cfg: CrossCoderConfig, mesh) -> tuple[Any, CrossCoderConfig]:
 
 
 def main(argv: list[str] | None = None) -> Trainer:
+    from crosscoder_tpu.parallel import multihost
+
+    distributed = multihost.initialize()   # no-op single-process
     cfg = CrossCoderConfig.from_cli(argv)
     mesh = mesh_lib.mesh_from_cfg(cfg)
+    if distributed:
+        print(f"[crosscoder_tpu] multihost: {multihost.process_info()}")
     buffer, cfg = build_buffer(cfg, mesh)
     trainer = Trainer(
         cfg, buffer, mesh=mesh,
-        logger=MetricsLogger(cfg),
+        # logging is a process-0 singleton; the checkpointer exists on every
+        # process (restore must run SPMD on all hosts or params diverge) and
+        # gates its writes on the primary itself
+        logger=MetricsLogger(cfg) if multihost.is_primary() else None,
         checkpointer=Checkpointer(cfg=cfg),
     )
     if cfg.resume:

@@ -168,7 +168,8 @@ class Trainer:
             self.logger.log(expand_metrics(metrics, self.cfg.n_sources), step)
 
     def save(self) -> None:
-        if self.checkpointer is not None:
+        # restore runs on every process (SPMD), but only the primary writes
+        if self.checkpointer is not None and jax.process_index() == 0:
             self.checkpointer.save(self.state, self.cfg, buffer=self.buffer)
 
     def train(self, num_steps: int | None = None) -> dict[str, float]:
