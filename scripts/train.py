@@ -9,7 +9,10 @@ Examples:
     python scripts/train.py --resume true
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from crosscoder_tpu.train.main import main
 
