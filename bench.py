@@ -73,19 +73,25 @@ def main() -> None:
         )
         for i in range(4)
     ]
+    # production serve path: raw bf16 rows + on-device per-source norm scale
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    scale = jax.device_put(
+        jnp.asarray([0.276, 0.244], jnp.float32), NamedSharding(mesh, PartitionSpec())
+    )
 
     # warmup / compile. NB: sync by FETCHING a scalar, not block_until_ready —
     # under a remote-tunnel TPU client block_until_ready can return before
     # the device has executed, which fakes ~1000x speedups; a device_get is
     # an honest round-trip on every backend.
     for i in range(3):
-        state, metrics = step_fn(state, batches[i % 4])
+        state, metrics = step_fn(state, batches[i % 4], scale)
     float(jax.device_get(metrics["loss"]))
 
     n_steps = int(os.environ.get("BENCH_STEPS", 50))
     t0 = time.perf_counter()
     for i in range(n_steps):
-        state, metrics = step_fn(state, batches[i % 4])
+        state, metrics = step_fn(state, batches[i % 4], scale)
     float(jax.device_get(metrics["loss"]))   # one ~70ms RTT amortized over n_steps
     dt = time.perf_counter() - t0
 

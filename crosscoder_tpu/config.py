@@ -90,6 +90,7 @@ class CrossCoderConfig:
     data_source: str = "gemma"      # gemma (paired-LM harvest) | synthetic
     model_names: tuple[str, ...] = ()  # HF ids to diff; default: (google/<model_name>, +"-it")
     resume: bool = False            # resume from the latest checkpoint version
+    prefetch: bool = True           # overlap host batch gather with the device step
     # master-weight/Adam-moment dtype. fp32 (default) is a quality upgrade
     # over the reference; "bf16" reproduces the reference exactly (its params
     # AND torch-Adam moments are bf16, train.py:5 + crosscoder.py:30-34) and

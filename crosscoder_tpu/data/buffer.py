@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from crosscoder_tpu import native
 from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.models import lm
 
@@ -193,13 +194,19 @@ class PairedActivationBuffer:
             acts = acts[:, 1:]                              # drop BOS (buffer.py:93)
             rows = acts.reshape(-1, cfg.n_sources, cfg.d_in)
             positions = self._perm[write: write + rows.shape[0]]
-            self._store[positions] = rows
+            native.scatter_rows(self._store, positions, rows)
             self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
             write += rows.shape[0]
         assert write == num_batches * rows_per_seq
         self._perm = self._rng.permutation(self.buffer_size)
         self.pointer = 0
         self._filled = True
+        # suffix-min of source provenance in serve order: makes the per-step
+        # stream snapshot (state_dict) O(1) instead of an O(buffer_size)
+        # min over the unserved tail on the hot serve path
+        self._suffix_min_src = np.minimum.accumulate(
+            self._src_global[self._perm][::-1]
+        )[::-1]
 
     def _take_tokens(self, n: int) -> np.ndarray:
         """Next ``n`` sequences, wrapping at the end of the corpus (the
@@ -214,9 +221,7 @@ class PairedActivationBuffer:
     # ------------------------------------------------------------------
     # serving
 
-    def next(self) -> np.ndarray:
-        """One training batch ``[batch_size, n_sources, d_in]`` fp32, norm
-        factors applied (reference ``buffer.py:115-125``)."""
+    def _next_idx(self) -> np.ndarray:
         cfg = self.cfg
         if not self._filled:
             raise RuntimeError(
@@ -224,11 +229,35 @@ class PairedActivationBuffer:
                 "(resume) or refresh() first"
             )
         idx = self._perm[self.pointer: self.pointer + cfg.batch_size]
-        out = self._store[idx].astype(np.float32)
         self.pointer += cfg.batch_size
-        if self.pointer > self.buffer_size // 2 - cfg.batch_size:
+        return idx
+
+    def next(self) -> np.ndarray:
+        """One training batch ``[batch_size, n_sources, d_in]`` fp32, norm
+        factors applied (reference ``buffer.py:115-125``). Gather, upcast,
+        and scale run as one fused native pass when the C++ kernels are
+        available (:mod:`crosscoder_tpu.native`)."""
+        idx = self._next_idx()
+        out = native.gather_scale_f32(self._store, idx, self.normalisation_factor)
+        if self.pointer > self.buffer_size // 2 - self.cfg.batch_size:
             self.refresh()                                   # buffer.py:121-122
-        return out * self.normalisation_factor[None, :, None]
+        return out
+
+    def next_raw(self) -> np.ndarray:
+        """One training batch as RAW bf16 rows ``[batch, n_sources, d_in]`` —
+        no upcast, no norm factors (they are in :attr:`normalisation_factor`).
+
+        The fast path for TPU training: half the host bytes and
+        host→device transfer of :meth:`next`; the trainer applies
+        ``x.astype(f32) * normalisation_factor`` inside the compiled step,
+        which is numerically identical to the reference's host-side
+        ``acts.float() * factor`` (reference ``buffer.py:123-124``).
+        """
+        idx = self._next_idx()
+        out = native.gather_rows(self._store, idx)
+        if self.pointer > self.buffer_size // 2 - self.cfg.batch_size:
+            self.refresh()                                   # buffer.py:121-122
+        return out
 
     # ------------------------------------------------------------------
     # resume support (no reference counterpart)
@@ -245,8 +274,11 @@ class PairedActivationBuffer:
         if not self._filled:
             return {"token_pointer": 0, "rng_state": self._rng.bit_generator.state,
                     "normalisation_factor": None}
-        unserved = self._perm[self.pointer:]
-        oldest = int(self._src_global[unserved].min()) if unserved.size else self._global_seq
+        oldest = (
+            int(self._suffix_min_src[self.pointer])
+            if self.pointer < self.buffer_size
+            else self._global_seq
+        )
         return {
             "token_pointer": oldest % self.tokens.shape[0],
             "rng_state": self._rng.bit_generator.state,

@@ -26,6 +26,7 @@ how activations are harvested.
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import Any, Callable
 
 import jax
@@ -43,18 +44,28 @@ from crosscoder_tpu.utils.logging import MetricsLogger, source_tag
 
 def make_train_step(
     cfg: CrossCoderConfig, mesh, tx, state_shardings
-) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict[str, jax.Array]]]:
-    """Build the compiled train step for a given mesh/optimizer."""
+) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the compiled train step for a given mesh/optimizer.
+
+    The returned function is ``step_fn(state, batch, scale)``: ``batch`` may
+    be fp32 rows already normalized (``scale`` of ones), or — the TPU fast
+    path — RAW bf16 rows straight out of the replay store with the
+    per-source norm factors in ``scale``; the upcast and multiply then run
+    on device, fused by XLA into the encode (numerically identical to the
+    reference's host-side ``acts.float() * factor``, reference
+    ``buffer.py:123-124``, at half the host→device bytes).
+    """
     lr_fn = schedules.lr_schedule(cfg)
     l1_fn = schedules.l1_coeff_schedule(cfg)
     loss_fn = cc.training_loss
     if cfg.remat:
         loss_fn = jax.checkpoint(loss_fn, static_argnums=(3,))
 
-    def step_fn(state: TrainState, batch: jax.Array):
+    def step_fn(state: TrainState, batch: jax.Array, scale: jax.Array):
+        x = batch.astype(jnp.float32) * scale[None, :, None]
         l1_coeff = l1_fn(state.step)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, losses), grads = grad_fn(state.params, batch, l1_coeff, cfg)
+        (loss, losses), grads = grad_fn(state.params, x, l1_coeff, cfg)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
@@ -72,9 +83,12 @@ def make_train_step(
         return new_state, metrics
 
     batch_sh = mesh_lib.batch_sharding(mesh)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    replicated = NamedSharding(mesh, PartitionSpec())
     return jax.jit(
         step_fn,
-        in_shardings=(state_shardings, batch_sh),
+        in_shardings=(state_shardings, batch_sh, replicated),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
     )
@@ -134,6 +148,29 @@ class Trainer:
         self.state = jax.device_put(state, self._state_shardings)
         self._step_fn = make_train_step(cfg, self.mesh, tx, self._state_shardings)
         self._batch_sharding = mesh_lib.batch_sharding(self.mesh)
+        # device-resident per-source scale for the raw-bf16 serve path; ones
+        # when the source already serves normalized fp32 (synthetic, tests)
+        self._scale_dev = None
+        self._scale_src = None
+        # one-deep prefetch: gather+transfer of batch i+1 overlaps the device
+        # executing step i (the C++ gather releases the GIL; see
+        # crosscoder_tpu/native). Single worker => the served stream and
+        # refresh schedule are byte-identical to the unprefetched loop.
+        self._prefetch_pool = None
+        self._pending = None
+        self._buffer_snapshot = None
+        # Narrows the window of interleaved jax enqueues between the main
+        # thread (step) and the prefetch worker (batch device_put). JAX
+        # dispatch is documented thread-safe — the buffer's own harvest
+        # dispatches intentionally stay concurrent with steps — but the
+        # trainer's two per-step enqueues are cheap to serialize.
+        self._dispatch_lock = threading.Lock()
+        if cfg.prefetch:
+            import concurrent.futures
+
+            self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="batch-prefetch"
+            )
 
     def restore(self, version_dir=None, save: int | None = None) -> dict:
         """Resume from a checkpoint: full TrainState + data-pipeline state
@@ -141,6 +178,7 @@ class Trainer:
         reference crosscoder.py:207-217)."""
         if self.checkpointer is None:
             raise ValueError("Trainer has no checkpointer to restore from")
+        self._drain_prefetch(discard=True)
         state, meta = self.checkpointer.restore(self.cfg, self._tx, version_dir, save)
         self.state = jax.device_put(state, self._state_shardings)
         if "buffer" in meta and hasattr(self.buffer, "load_state_dict"):
@@ -156,11 +194,91 @@ class Trainer:
     def step_counter(self) -> int:
         return int(self.state.step)
 
+    def _device_scale(self) -> jax.Array:
+        """Replicated per-source scale, cached until the buffer's factors
+        change object identity (calibration / resume)."""
+        import numpy as np_
+
+        src = getattr(self.buffer, "normalisation_factor", None)
+        raw = hasattr(self.buffer, "next_raw")
+        key = id(src) if raw else "ones"
+        if self._scale_src != key:
+            vec = (
+                np_.asarray(src, np_.float32)
+                if raw and src is not None
+                else np_.ones((self.cfg.n_sources,), np_.float32)
+            )
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._scale_dev = jax.device_put(
+                vec, NamedSharding(self.mesh, PartitionSpec())
+            )
+            self._scale_src = key
+        return self._scale_dev
+
+    def _produce_batch(self) -> tuple[jax.Array, jax.Array]:
+        """Gather the next batch and start its host→device transfer.
+
+        Runs on the prefetch worker when prefetching is on. Raw-bf16 serving
+        (``next_raw``) is preferred: the norm factors ride separately and are
+        applied inside the compiled step.
+        """
+        if hasattr(self.buffer, "next_raw"):
+            batch = self.buffer.next_raw()
+        else:
+            batch = self.buffer.next()
+        with self._dispatch_lock:
+            return jax.device_put(batch, self._batch_sharding), self._device_scale()
+
+    def _submit_prefetch(self) -> None:
+        # Stream-state snapshot BEFORE producing the next batch: a checkpoint
+        # written while batch i+1 sits prefetched must record the stream at
+        # position i+1's start, or resume would skip that batch (the buffer
+        # is quiescent here — the previous production was just consumed).
+        if hasattr(self.buffer, "state_dict"):
+            self._buffer_snapshot = self.buffer.state_dict()
+        self._pending = self._prefetch_pool.submit(self._produce_batch)
+
+    def _next_batch(self) -> tuple[jax.Array, jax.Array]:
+        if self._prefetch_pool is None:
+            return self._produce_batch()
+        if self._pending is None:
+            self._submit_prefetch()
+        out = self._pending.result()
+        self._submit_prefetch()
+        return out
+
+    def _drain_prefetch(self, discard: bool = False) -> None:
+        """Wait for in-flight batch production so buffer state is quiescent
+        (checkpointing); ``discard`` additionally drops the produced batch
+        (restore: the stream position it came from is being replaced).
+
+        A failure in the SPECULATIVE batch (one past what training consumed —
+        e.g. an exhausted source) must not abort the checkpoint being
+        written; it is swallowed here and will re-raise on the main thread
+        if and when that batch is actually consumed by ``step()``.
+        """
+        if self._pending is not None:
+            try:
+                self._pending.result()
+            except Exception:
+                pass
+            finally:
+                if discard:
+                    self._pending = None
+                    self._buffer_snapshot = None
+
+    def close(self) -> None:
+        if self._prefetch_pool is not None:
+            self._prefetch_pool.shutdown(wait=True)
+            self._prefetch_pool = None
+            self._pending = None
+
     def step(self) -> dict[str, jax.Array]:
         """One optimizer step; returns device-resident metrics (no sync)."""
-        batch = self.buffer.next()
-        batch = jax.device_put(batch, self._batch_sharding)
-        self.state, metrics = self._step_fn(self.state, batch)
+        batch, scale = self._next_batch()
+        with self._dispatch_lock:
+            self.state, metrics = self._step_fn(self.state, batch, scale)
         return metrics
 
     def log(self, metrics: dict[str, Any], step: int) -> None:
@@ -170,7 +288,17 @@ class Trainer:
     def save(self) -> None:
         # restore runs on every process (SPMD), but only the primary writes
         if self.checkpointer is not None and jax.process_index() == 0:
-            self.checkpointer.save(self.state, self.cfg, buffer=self.buffer)
+            # quiesce the prefetch worker (no mid-next() device contention),
+            # then checkpoint the PRE-prefetch stream snapshot so resume
+            # replays the in-flight batch instead of skipping it
+            self._drain_prefetch()
+            buffer = self.buffer
+            if self._pending is not None and self._buffer_snapshot is not None:
+                import types
+
+                snap = self._buffer_snapshot
+                buffer = types.SimpleNamespace(state_dict=lambda: snap)
+            self.checkpointer.save(self.state, self.cfg, buffer=buffer)
 
     def train(self, num_steps: int | None = None) -> dict[str, float]:
         """Run the training loop (reference ``trainer.py:72-82`` semantics:
@@ -214,6 +342,7 @@ class Trainer:
             if profiling:
                 jax.profiler.stop_trace()
             self.save()
+            self.close()
             if self.logger is not None:
                 self.logger.close()
         return expand_metrics(jax.device_get(metrics), self.cfg.n_sources) if metrics else {}

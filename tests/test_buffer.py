@@ -247,3 +247,37 @@ def test_save_before_first_fill_resumes_from_scratch(lm_pair, tokens):
     b2.load_state_dict(state)
     assert b2._filled and b2.token_pointer == 64
     assert b2.next().shape == (32, 2, 32)
+
+
+def test_next_raw_matches_next(lm_pair, tokens):
+    """Raw-bf16 serving + on-host upcast·scale == the fp32 serve path, bit
+    for bit — so the trainer's on-device scale path (trainer step_fn) is the
+    same stream the reference serves (reference buffer.py:115-125)."""
+    lm_cfg, params = lm_pair
+    a = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    for _ in range(4):
+        served = a.next()
+        raw = b.next_raw()
+        scaled = raw.astype(np.float32) * b.normalisation_factor[None, :, None]
+        assert np.array_equal(served, scaled)
+
+
+def test_native_and_numpy_serve_identically(lm_pair, tokens, monkeypatch):
+    """The C++ gather/scatter kernels and the NumPy fallback produce the
+    same buffer trajectory (fills + serves) byte-identically."""
+    from crosscoder_tpu import native
+
+    if not native.available():
+        pytest.skip("native kernels unavailable")
+    lm_cfg, params = lm_pair
+    a = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    batches_native = [a.next() for _ in range(6)]
+
+    # force the numpy fallback and replay
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_lib_err", "forced-off for test")
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    batches_numpy = [b.next() for _ in range(6)]
+    for x, y in zip(batches_native, batches_numpy):
+        assert np.array_equal(x, y)

@@ -1,0 +1,76 @@
+"""Native host-kernel tests: the C++ data-plane ops must be byte-identical
+to their NumPy fallbacks (SURVEY.md §4 parity-test strategy applied to the
+framework's own native tier — the reference has no native code to mirror,
+SURVEY.md §2 'native-code statement').
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from crosscoder_tpu import native
+
+BF16 = np.dtype(jnp.bfloat16.dtype)
+
+
+def _random_store(rng, n=257, n_sources=3, d_in=19):
+    # include denormals/inf/nan bit patterns: kernels move raw bits and the
+    # upcast is a pure shift, so special values must survive exactly
+    bits = rng.integers(0, 2**16, size=(n, n_sources, d_in), dtype=np.uint16)
+    return bits.view(BF16)
+
+
+def test_native_builds():
+    assert native.available(), native.build_error()
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.default_rng(0)
+    store = _random_store(rng)
+    idx = rng.integers(0, store.shape[0], size=64)
+    out = native.gather_rows(store, idx)
+    assert out.dtype == store.dtype and out.shape == (64,) + store.shape[1:]
+    assert np.array_equal(out.view(np.uint16), store[idx].view(np.uint16))
+
+
+def test_gather_scale_f32_matches_numpy():
+    rng = np.random.default_rng(1)
+    store = _random_store(rng)
+    # keep scales finite/normal; inf*0-style NaN propagation must also match
+    scale = rng.uniform(0.1, 2.0, size=store.shape[1]).astype(np.float32)
+    idx = rng.integers(0, store.shape[0], size=128)
+    out = native.gather_scale_f32(store, idx, scale)
+    with np.errstate(over="ignore", invalid="ignore"):  # inf/nan rows on purpose
+        ref = store[idx].astype(np.float32) * scale[None, :, None]
+    assert out.dtype == np.float32
+    # bit-level equality, NaNs included
+    assert np.array_equal(out.view(np.uint32), ref.view(np.uint32))
+
+
+def test_scatter_rows_matches_numpy():
+    rng = np.random.default_rng(2)
+    store_a = _random_store(rng)
+    store_b = store_a.copy()
+    pos = rng.permutation(store_a.shape[0])[:50]
+    rows = _random_store(rng, n=50, n_sources=store_a.shape[1], d_in=store_a.shape[2])
+    store_a[pos] = rows
+    native.scatter_rows(store_b, pos, rows)
+    assert np.array_equal(store_a.view(np.uint16), store_b.view(np.uint16))
+
+
+def test_gather_rejects_non_contiguous():
+    rng = np.random.default_rng(3)
+    store = _random_store(rng)[:, ::2, :]  # non-contiguous view
+    if not native.available():
+        pytest.skip("numpy fallback accepts anything")
+    with pytest.raises(ValueError, match="contiguous"):
+        native.gather_rows(store, np.array([0, 1]))
+
+
+def test_gather_rejects_wrong_scale_shape():
+    if not native.available():
+        pytest.skip("native only")
+    rng = np.random.default_rng(4)
+    store = _random_store(rng)
+    with pytest.raises(ValueError, match="scale"):
+        native.gather_scale_f32(store, np.array([0]), np.ones(store.shape[1] + 1, np.float32))

@@ -104,7 +104,7 @@ def test_one_step_optimizer_parity_with_torch():
     params0 = {k: np.asarray(v).copy() for k, v in state.params.items()}  # before donation
     mesh = mesh_lib.make_mesh(devices=jax.devices()[:1])
     step_fn = make_train_step(cfg, mesh, tx, mesh_lib.state_shardings(mesh, state))
-    new_state, _ = step_fn(state, jnp.asarray(x))
+    new_state, _ = step_fn(state, jnp.asarray(x), jnp.ones((cfg.n_sources,), jnp.float32))
 
     # torch mirror: same params, same batch, l1_coeff at step 0 (= 0 warmup)
     tp = {k: torch.nn.Parameter(torch.from_numpy(v.copy())) for k, v in params0.items()}
@@ -132,3 +132,52 @@ def test_trainer_train_loop_runs_with_logger(tmp_path, capsys):
     assert "loss" in final
     logged = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
     assert len(logged) == 3  # steps 0, 5, 10
+
+
+def test_prefetch_off_matches_on():
+    """The one-deep prefetch worker must not change the training trajectory:
+    same synthetic stream, same final params (bitwise)."""
+    a = Trainer(tiny_cfg(prefetch=False))
+    b = Trainer(tiny_cfg(prefetch=True))
+    for _ in range(7):
+        a.step()
+        b.step()
+    pa = jax.device_get(a.state.params)
+    pb = jax.device_get(b.state.params)
+    b.close()
+    for k in pa:
+        assert np.array_equal(np.asarray(pa[k]), np.asarray(pb[k])), k
+
+
+def test_raw_bf16_source_matches_fp32_source():
+    """A source serving raw bf16 + norm factors (the buffer's next_raw
+    contract) trains identically to one serving pre-scaled fp32 — the
+    on-device `astype(f32) * scale` is the reference's host-side math
+    (reference buffer.py:123-124) moved into the compiled step."""
+    cfg = tiny_cfg(num_tokens=256 * 50)
+    factors = np.array([0.7, 1.3], np.float32)
+    rng = np.random.default_rng(11)
+    raw = [rng.standard_normal((cfg.batch_size, 2, cfg.d_in)).astype(jnp.bfloat16.dtype) for _ in range(6)]
+
+    class RawSrc:
+        normalisation_factor = factors
+        def __init__(self): self.i = 0
+        def next_raw(self):
+            x = raw[self.i]; self.i += 1; return x
+
+    class F32Src:
+        def __init__(self): self.i = 0
+        def next(self):
+            x = raw[self.i].astype(np.float32) * factors[None, :, None]
+            self.i += 1
+            return x
+
+    a = Trainer(cfg, buffer=RawSrc())
+    b = Trainer(cfg, buffer=F32Src())
+    for _ in range(6):
+        a.step()
+        b.step()
+    pa, pb = jax.device_get(a.state.params), jax.device_get(b.state.params)
+    a.close(); b.close()
+    for k in pa:
+        assert np.allclose(np.asarray(pa[k]), np.asarray(pb[k]), atol=1e-6), k
