@@ -128,8 +128,16 @@ def forward(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> jax.Array:
     return decode(params, encode(params, x, cfg))
 
 
-def get_losses(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> LossOutput:
+def get_losses(
+    params: Params, x: jax.Array, cfg: CrossCoderConfig, with_metrics: bool = True
+) -> LossOutput:
     """Full loss surface for a batch ``x: [batch, n_sources, d_in]``.
+
+    ``with_metrics=False`` skips the metric-only reductions (l0 and the
+    explained variances — several extra full passes over the batch/latents,
+    ~13% of a TPU train step) and returns zeros in their slots; the
+    objective terms (l2, weighted l1) are always computed. The trainer uses
+    this off log-steps; numerics of the objective are identical.
 
     Numerics follow reference ``crosscoder.py:96-130`` exactly, with the
     fp32 upcast for all loss reductions (reference ``crosscoder.py:104``):
@@ -151,6 +159,23 @@ def get_losses(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> LossOutpu
     l2_per_row = jnp.sum(err2, axis=(-2, -1))             # [B]
     l2_loss = jnp.mean(l2_per_row)
 
+    ff = f.astype(jnp.float32)
+    dec_norms = jnp.linalg.norm(params["W_dec"].astype(jnp.float32), axis=-1)  # [H, n]
+    total_dec_norm = jnp.sum(dec_norms, axis=-1)          # [H]
+    l1_loss = jnp.mean(jnp.sum(ff * total_dec_norm[None, :], axis=-1))
+
+    if not with_metrics:
+        zero = jnp.zeros((), jnp.float32)
+        return LossOutput(
+            l2_loss=l2_loss,
+            l1_loss=l1_loss,
+            l0_loss=zero,
+            explained_variance=jnp.zeros_like(l2_per_row),
+            explained_variance_per_source=jnp.zeros(
+                (x.shape[-2], x.shape[0]), jnp.float32
+            ),
+        )
+
     eps = 1e-8
     centered = xf - jnp.mean(xf, axis=0, keepdims=True)
     tot_var = jnp.sum(jnp.square(centered), axis=(-2, -1))  # [B]
@@ -161,11 +186,6 @@ def get_losses(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> LossOutpu
     l2_per_source = jnp.sum(err2, axis=-1)                # [B, n]
     var_per_source = jnp.sum(jnp.square(centered), axis=-1)  # [B, n]
     ev_per_source = 1.0 - l2_per_source / (var_per_source + eps)  # [B, n]
-
-    ff = f.astype(jnp.float32)
-    dec_norms = jnp.linalg.norm(params["W_dec"].astype(jnp.float32), axis=-1)  # [H, n]
-    total_dec_norm = jnp.sum(dec_norms, axis=-1)          # [H]
-    l1_loss = jnp.mean(jnp.sum(ff * total_dec_norm[None, :], axis=-1))
 
     l0_loss = jnp.mean(jnp.sum((ff > 0).astype(jnp.float32), axis=-1))
 
@@ -187,7 +207,11 @@ def cast_params(params: Params, dtype: jnp.dtype) -> Params:
 
 
 def training_loss(
-    params: Params, x: jax.Array, l1_coeff: jax.Array | float, cfg: CrossCoderConfig
+    params: Params,
+    x: jax.Array,
+    l1_coeff: jax.Array | float,
+    cfg: CrossCoderConfig,
+    with_metrics: bool = True,
 ) -> tuple[jax.Array, LossOutput]:
     """Scalar training objective ``l2 + l1_coeff · l1`` (reference
     ``trainer.py:44``) plus the full loss surface as aux.
@@ -195,7 +219,9 @@ def training_loss(
     Params may be fp32 masters; they are cast to ``cfg.enc_dtype`` here so
     the einsums hit the MXU in bf16 while gradients accumulate into fp32.
     """
-    losses = get_losses(cast_params(params, dtype_of(cfg.enc_dtype)), x, cfg)
+    losses = get_losses(
+        cast_params(params, dtype_of(cfg.enc_dtype)), x, cfg, with_metrics
+    )
     # TopK-style runs control sparsity structurally and typically set
     # l1_coeff=0 in config; the objective shape is the same either way.
     loss = losses.l2_loss + l1_coeff * losses.l1_loss

@@ -34,8 +34,8 @@ _lib = None
 _lib_err: str | None = None
 _lock = threading.Lock()
 
-# single-core hosts gain nothing from threads; cap modestly elsewhere
-N_THREADS = max(1, min(8, (os.cpu_count() or 1) - 0))
+# leave one core for the main thread's jax dispatch; cap modestly
+N_THREADS = max(1, min(8, (os.cpu_count() or 1) - 1))
 
 
 def _so_path() -> Path:
@@ -117,6 +117,16 @@ def _check_2d_bf16_c(store: np.ndarray, name: str) -> tuple[np.ndarray, int]:
     return store.view(np.uint16).reshape(n, row_elems), row_elems
 
 
+def _check_idx(idx: np.ndarray, n: int, name: str = "idx") -> np.ndarray:
+    """Bounds-check indices before handing raw pointers to C — the NumPy
+    fallback raises IndexError, and the native path must fail the same way
+    rather than corrupt memory."""
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= n):
+        raise IndexError(f"{name} out of range for store of {n} rows")
+    return idx
+
+
 def gather_rows(store: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """``store[idx]`` for a C-contiguous bf16 store (any trailing shape).
 
@@ -126,7 +136,7 @@ def gather_rows(store: np.ndarray, idx: np.ndarray) -> np.ndarray:
     if lib is None:
         return store[idx]
     flat, row_elems = _check_2d_bf16_c(store, "store")
-    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    idx = _check_idx(idx, store.shape[0])
     out = np.empty((idx.shape[0],) + store.shape[1:], dtype=store.dtype)
     lib.gather_rows_bf16(
         flat.ctypes.data, idx.ctypes.data, idx.shape[0], row_elems,
@@ -146,9 +156,13 @@ def gather_scale_f32(store: np.ndarray, idx: np.ndarray,
         return store[idx].astype(np.float32) * np.asarray(scale, np.float32)[None, :, None]
     if store.ndim != 3:
         raise ValueError(f"store must be [N, n_sources, d_in], got {store.shape}")
+    if store.dtype.name != "bfloat16":
+        # the upcast kernel shifts bf16 bit patterns; fp16/int16 would be
+        # silently reinterpreted as garbage, unlike the pure byte-move ops
+        raise ValueError(f"store must be bfloat16, got {store.dtype}")
     flat, _ = _check_2d_bf16_c(store, "store")
     n_sources, d_in = store.shape[1], store.shape[2]
-    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    idx = _check_idx(idx, store.shape[0])
     scale = np.ascontiguousarray(scale, dtype=np.float32)
     if scale.shape != (n_sources,):
         raise ValueError(f"scale must be [{n_sources}], got {scale.shape}")
@@ -170,7 +184,7 @@ def scatter_rows(store: np.ndarray, pos: np.ndarray, rows: np.ndarray) -> None:
     rows = np.ascontiguousarray(rows)
     if rows.dtype != store.dtype or rows.shape[1:] != store.shape[1:]:
         raise ValueError(f"rows {rows.shape}/{rows.dtype} does not match store {store.shape}/{store.dtype}")
-    pos = np.ascontiguousarray(pos, dtype=np.int64)
+    pos = _check_idx(pos, store.shape[0], "pos")
     rflat = rows.view(np.uint16).reshape(rows.shape[0], row_elems)
     lib.scatter_rows_bf16(
         flat.ctypes.data, pos.ctypes.data, rflat.ctypes.data,

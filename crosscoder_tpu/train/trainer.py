@@ -25,14 +25,18 @@ how activations are harvested.
 
 from __future__ import annotations
 
+import concurrent.futures
 import contextlib
+import functools
 import threading
+import types
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import NamedSharding, PartitionSpec
 
 from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.models import crosscoder as cc
@@ -43,7 +47,7 @@ from crosscoder_tpu.utils.logging import MetricsLogger, source_tag
 
 
 def make_train_step(
-    cfg: CrossCoderConfig, mesh, tx, state_shardings
+    cfg: CrossCoderConfig, mesh, tx, state_shardings, with_metrics: bool = True
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the compiled train step for a given mesh/optimizer.
 
@@ -57,34 +61,35 @@ def make_train_step(
     """
     lr_fn = schedules.lr_schedule(cfg)
     l1_fn = schedules.l1_coeff_schedule(cfg)
-    loss_fn = cc.training_loss
+    loss_fn = functools.partial(cc.training_loss, cfg=cfg, with_metrics=with_metrics)
     if cfg.remat:
-        loss_fn = jax.checkpoint(loss_fn, static_argnums=(3,))
+        loss_fn = jax.checkpoint(loss_fn)
 
     def step_fn(state: TrainState, batch: jax.Array, scale: jax.Array):
         x = batch.astype(jnp.float32) * scale[None, :, None]
         l1_coeff = l1_fn(state.step)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, losses), grads = grad_fn(state.params, x, l1_coeff, cfg)
+        (loss, losses), grads = grad_fn(state.params, x, l1_coeff)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
             "loss": loss,
             "l2_loss": losses.l2_loss,
             "l1_loss": losses.l1_loss,
-            "l0_loss": losses.l0_loss,
             "l1_coeff": l1_coeff,
             "lr": lr_fn(state.step),
-            "explained_variance": jnp.mean(losses.explained_variance),
         }
-        ev_src = jnp.mean(losses.explained_variance_per_source, axis=-1)  # [n_sources]
-        metrics["explained_variance_per_source"] = ev_src
+        if with_metrics:
+            metrics["l0_loss"] = losses.l0_loss
+            metrics["explained_variance"] = jnp.mean(losses.explained_variance)
+            # [n_sources]
+            metrics["explained_variance_per_source"] = jnp.mean(
+                losses.explained_variance_per_source, axis=-1
+            )
         new_state = TrainState(new_params, new_opt, state.step + 1)
         return new_state, metrics
 
     batch_sh = mesh_lib.batch_sharding(mesh)
-    from jax.sharding import NamedSharding, PartitionSpec
-
     replicated = NamedSharding(mesh, PartitionSpec())
     return jax.jit(
         step_fn,
@@ -147,6 +152,7 @@ class Trainer:
         self._state_shardings = mesh_lib.state_shardings(self.mesh, state)
         self.state = jax.device_put(state, self._state_shardings)
         self._step_fn = make_train_step(cfg, self.mesh, tx, self._state_shardings)
+        self._step_fn_bare = None   # compiled on first off-log-step use
         self._batch_sharding = mesh_lib.batch_sharding(self.mesh)
         # device-resident per-source scale for the raw-bf16 serve path; ones
         # when the source already serves normalized fp32 (synthetic, tests)
@@ -166,8 +172,6 @@ class Trainer:
         # trainer's two per-step enqueues are cheap to serialize.
         self._dispatch_lock = threading.Lock()
         if cfg.prefetch:
-            import concurrent.futures
-
             self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="batch-prefetch"
             )
@@ -195,25 +199,19 @@ class Trainer:
         return int(self.state.step)
 
     def _device_scale(self) -> jax.Array:
-        """Replicated per-source scale, cached until the buffer's factors
-        change object identity (calibration / resume)."""
-        import numpy as np_
-
+        """Replicated per-source scale, re-uploaded only when the factors'
+        VALUES change (calibration / resume) — cached by value, not object
+        identity, since numpy can reuse a freed allocation's id."""
         src = getattr(self.buffer, "normalisation_factor", None)
-        raw = hasattr(self.buffer, "next_raw")
-        key = id(src) if raw else "ones"
-        if self._scale_src != key:
-            vec = (
-                np_.asarray(src, np_.float32)
-                if raw and src is not None
-                else np_.ones((self.cfg.n_sources,), np_.float32)
-            )
-            from jax.sharding import NamedSharding, PartitionSpec
-
+        if hasattr(self.buffer, "next_raw") and src is not None:
+            vec = np.asarray(src, np.float32)
+        else:
+            vec = np.ones((self.cfg.n_sources,), np.float32)
+        if self._scale_src is None or not np.array_equal(self._scale_src, vec):
             self._scale_dev = jax.device_put(
                 vec, NamedSharding(self.mesh, PartitionSpec())
             )
-            self._scale_src = key
+            self._scale_src = vec.copy()
         return self._scale_dev
 
     def _produce_batch(self) -> tuple[jax.Array, jax.Array]:
@@ -257,8 +255,17 @@ class Trainer:
         e.g. an exhausted source) must not abort the checkpoint being
         written; it is swallowed here and will re-raise on the main thread
         if and when that batch is actually consumed by ``step()``.
+
+        A production that has not started yet is cancelled instead of
+        awaited — it may hide a multi-second half-buffer re-harvest whose
+        result would be thrown away (restore) or never consumed (final
+        save); on successful cancel the live buffer state IS the snapshot.
         """
         if self._pending is not None:
+            if self._pending.cancel():
+                self._pending = None
+                self._buffer_snapshot = None
+                return
             try:
                 self._pending.result()
             except Exception:
@@ -274,11 +281,26 @@ class Trainer:
             self._prefetch_pool = None
             self._pending = None
 
-    def step(self) -> dict[str, jax.Array]:
-        """One optimizer step; returns device-resident metrics (no sync)."""
+    def step(self, full_metrics: bool = True) -> dict[str, jax.Array]:
+        """One optimizer step; returns device-resident metrics (no sync).
+
+        ``full_metrics=False`` runs the bare variant — identical parameter
+        update, but the metric-only reductions (l0, explained variances;
+        ~13% of the step on TPU) are compiled out and absent from the
+        returned dict. ``train()`` uses it off log-steps.
+        """
+        if full_metrics:
+            fn = self._step_fn
+        else:
+            if self._step_fn_bare is None:
+                self._step_fn_bare = make_train_step(
+                    self.cfg, self.mesh, self._tx, self._state_shardings,
+                    with_metrics=False,
+                )
+            fn = self._step_fn_bare
         batch, scale = self._next_batch()
         with self._dispatch_lock:
-            self.state, metrics = self._step_fn(self.state, batch, scale)
+            self.state, metrics = fn(self.state, batch, scale)
         return metrics
 
     def log(self, metrics: dict[str, Any], step: int) -> None:
@@ -294,8 +316,6 @@ class Trainer:
             self._drain_prefetch()
             buffer = self.buffer
             if self._pending is not None and self._buffer_snapshot is not None:
-                import types
-
                 snap = self._buffer_snapshot
                 buffer = types.SimpleNamespace(state_dict=lambda: snap)
             self.checkpointer.save(self.state, self.cfg, buffer=buffer)
@@ -322,7 +342,7 @@ class Trainer:
                 if self.cfg.profile_dir and i == start + 10:
                     jax.profiler.start_trace(self.cfg.profile_dir)
                     profiling = True
-                metrics = self.step()
+                metrics = self.step(full_metrics=(i % self.cfg.log_every == 0))
                 if profiling and i >= start + 14:
                     float(jax.device_get(metrics["loss"]))
                     jax.profiler.stop_trace()

@@ -74,3 +74,24 @@ def test_gather_rejects_wrong_scale_shape():
     store = _random_store(rng)
     with pytest.raises(ValueError, match="scale"):
         native.gather_scale_f32(store, np.array([0]), np.ones(store.shape[1] + 1, np.float32))
+
+
+def test_native_bounds_check_raises_indexerror():
+    """Out-of-range indices must raise (like the NumPy fallback), never
+    touch memory."""
+    rng = np.random.default_rng(5)
+    store = _random_store(rng)
+    with pytest.raises(IndexError):
+        native.gather_rows(store, np.array([store.shape[0]]))
+    with pytest.raises(IndexError):
+        native.gather_scale_f32(store, np.array([-1]), np.ones(store.shape[1], np.float32))
+    with pytest.raises(IndexError):
+        native.scatter_rows(store, np.array([store.shape[0] + 3]), store[:1].copy())
+
+
+def test_gather_scale_rejects_float16():
+    if not native.available():
+        pytest.skip("native only")
+    store = np.zeros((8, 2, 4), np.float16)
+    with pytest.raises(ValueError, match="bfloat16"):
+        native.gather_scale_f32(store, np.array([0]), np.ones(2, np.float32))
