@@ -70,6 +70,10 @@ class PairedActivationBuffer:
         token batches (mesh ``data`` axis; component N5).
     """
 
+    # harvest chunks kept in flight during refresh: device compute overlaps
+    # host fetch+scatter (1 = fully serial, the reference's behavior)
+    PIPELINE_DEPTH = 3
+
     def __init__(
         self,
         cfg: CrossCoderConfig,
@@ -128,45 +132,77 @@ class PairedActivationBuffer:
     # ------------------------------------------------------------------
     # harvest
 
-    def _harvest(self, token_batch: np.ndarray) -> np.ndarray:
-        """All sources' hook activations for one token chunk:
-        ``[B, S, n_sources, d_in]`` (source axis model-major, matching the
-        crosscoder's ``n_sources = n_models × n_hooked_layers``)."""
+    def _pad_chunk(self, token_batch: np.ndarray) -> tuple[np.ndarray, int]:
+        """Pad a ragged chunk to the fixed harvest shape: keeps dim 0
+        divisible by the mesh data axis and avoids per-shape recompiles."""
         n = token_batch.shape[0]
         if n != self._chunk_seqs:
-            # pad ragged chunks to the fixed harvest shape: keeps dim 0
-            # divisible by the mesh data axis and avoids per-shape recompiles
             assert n < self._chunk_seqs, (n, self._chunk_seqs)
             pad = np.broadcast_to(token_batch[:1], (self._chunk_seqs - n, *token_batch.shape[1:]))
             token_batch = np.concatenate([token_batch, pad])
-        tok = jnp.asarray(token_batch)
+        return token_batch, n
+
+    def _harvest_dev(self, padded_tokens: np.ndarray) -> jax.Array:
+        """All sources' hook activations for one fixed-shape token chunk,
+        DEVICE-resident ``[C, S, n_sources, d_in]`` bf16 (source axis
+        model-major, matching ``n_sources = n_models × n_hooked_layers``).
+
+        No host sync: the result is a future, so callers can pipeline
+        several chunks' forwards against host-side fetch/scatter work.
+        """
+        tok = jnp.asarray(padded_tokens)
         if self.batch_sharding is not None:
             tok = jax.device_put(tok, self.batch_sharding)
         per_source = []
         for params in self.model_params:
             cache = lm.run_with_cache(params, tok, self.lm_cfg, self.hook_points)
             per_source.extend(cache[hp] for hp in self.hook_points)
-        stacked = jnp.stack(per_source, axis=2)             # [B, S, n_sources, d]
-        return np.asarray(jax.device_get(stacked.astype(jnp.bfloat16)))[:n]
+        return jnp.stack(per_source, axis=2).astype(jnp.bfloat16)
+
+    def _harvest(self, token_batch: np.ndarray) -> np.ndarray:
+        """Blocking harvest of one (possibly ragged) chunk → host array."""
+        padded, n = self._pad_chunk(token_batch)
+        return np.asarray(jax.device_get(self._harvest_dev(padded)))[:n]
 
     def _estimate_norm_scaling_factors(self) -> np.ndarray:
         """Per-source ``sqrt(d_in) / mean_token_norm`` (reference
         ``buffer.py:44-63``; adapted there from SAELens). Means include every
-        position, BOS included, as the reference's do. Under a sharded
-        harvest the mean is a global psum-mean — XLA inserts the collective
-        from the sharding (SURVEY component N1)."""
+        position, BOS included, as the reference's do.
+
+        TPU-native shape: the per-chunk norm sums reduce ON DEVICE to a
+        ``[n_sources]`` vector and accumulate there across chunks — one
+        scalar-sized fetch at the very end instead of shipping every
+        ``[B, S, n, d]`` chunk to host (the reference pulls all 800 forwards'
+        activations through host memory). Under a sharded harvest the
+        reduction is a psum-mean — XLA inserts the collective from the
+        sharding (SURVEY component N1)."""
         cfg = self.cfg
         n_seqs = cfg.norm_calib_batches * cfg.model_batch_size
         if n_seqs > self.tokens.shape[0]:
             n_seqs = self.tokens.shape[0]
-        sums = np.zeros((cfg.n_sources,), dtype=np.float64)
+
+        @jax.jit
+        def chunk_norm_sums(acts: jax.Array, n_valid: jax.Array) -> jax.Array:
+            norms = jnp.linalg.norm(acts.astype(jnp.float32), axis=-1)  # [C,S,n]
+            mask = (jnp.arange(acts.shape[0]) < n_valid)[:, None, None]
+            return jnp.sum(norms * mask, axis=(0, 1))                   # [n]
+
+        # same bounded pipeline as refresh(): a few chunk forwards in
+        # flight, each chunk's [n_sources] partial sum fetched with lag and
+        # accumulated host-side in float64 (unbounded enqueue would fill
+        # HBM with queued activation intermediates)
+        sums = np.zeros((cfg.n_sources,), np.float64)
         count = 0
+        inflight: list = []
         for start in range(0, n_seqs, self._chunk_seqs):
             chunk = self.tokens[start: start + self._chunk_seqs][:n_seqs - start]
-            acts = self._harvest(chunk).astype(np.float32)  # [B, S, n, d]
-            norms = np.linalg.norm(acts, axis=-1)           # [B, S, n]
-            sums += norms.sum(axis=(0, 1))
-            count += norms.shape[0] * norms.shape[1]
+            padded, n = self._pad_chunk(chunk)
+            inflight.append(chunk_norm_sums(self._harvest_dev(padded), jnp.int32(n)))
+            count += n * chunk.shape[1]
+            if len(inflight) >= self.PIPELINE_DEPTH:
+                sums += np.asarray(jax.device_get(inflight.pop(0)), np.float64)
+        for part in inflight:
+            sums += np.asarray(jax.device_get(part), np.float64)
         mean_norm = sums / max(count, 1)
         return (np.sqrt(cfg.d_in) / mean_norm).astype(np.float32)
 
@@ -185,19 +221,35 @@ class PairedActivationBuffer:
         self.first = False
         rows_per_seq = cfg.seq_len - 1
         write = 0
+
+        def drain(item) -> int:
+            acts_dev, n, seq_globals, woff = item
+            acts = np.asarray(jax.device_get(acts_dev))[:n]
+            acts = acts[:, 1:]                              # drop BOS (buffer.py:93)
+            rows = acts.reshape(-1, cfg.n_sources, cfg.d_in)
+            positions = self._perm[woff: woff + rows.shape[0]]
+            native.scatter_rows(self._store, positions, rows)
+            self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
+            return rows.shape[0]
+
+        # Pipelined harvest: keep a few chunks' forwards in flight so device
+        # compute overlaps the host-side fetch + scatter (the device_get here
+        # is the only sync point; issuing it per-chunk serially would pay a
+        # full round trip per chunk on remote-tunnel TPU clients).
+        inflight: list = []
+        depth = self.PIPELINE_DEPTH
+        drained = 0
         for start in range(0, num_batches, self._chunk_seqs):
             stop = min(start + self._chunk_seqs, num_batches)
             n_seqs = stop - start
             seq_globals = self._global_seq + np.arange(n_seqs)
-            chunk = self._take_tokens(n_seqs)
-            acts = self._harvest(chunk)                     # [B, S, n, d]
-            acts = acts[:, 1:]                              # drop BOS (buffer.py:93)
-            rows = acts.reshape(-1, cfg.n_sources, cfg.d_in)
-            positions = self._perm[write: write + rows.shape[0]]
-            native.scatter_rows(self._store, positions, rows)
-            self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
-            write += rows.shape[0]
-        assert write == num_batches * rows_per_seq
+            padded, n = self._pad_chunk(self._take_tokens(n_seqs))
+            inflight.append((self._harvest_dev(padded), n, seq_globals, write))
+            write += n * rows_per_seq
+            if len(inflight) >= depth:
+                drained += drain(inflight.pop(0))
+        drained += sum(drain(item) for item in inflight)
+        assert drained == write == num_batches * rows_per_seq
         self._perm = self._rng.permutation(self.buffer_size)
         self.pointer = 0
         self._filled = True

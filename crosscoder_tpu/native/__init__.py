@@ -118,12 +118,18 @@ def _check_2d_bf16_c(store: np.ndarray, name: str) -> tuple[np.ndarray, int]:
 
 
 def _check_idx(idx: np.ndarray, n: int, name: str = "idx") -> np.ndarray:
-    """Bounds-check indices before handing raw pointers to C — the NumPy
-    fallback raises IndexError, and the native path must fail the same way
-    rather than corrupt memory."""
+    """Normalize + bounds-check indices before handing raw pointers to C.
+
+    Matches NumPy indexing semantics exactly: negatives in [-n, -1] wrap,
+    anything outside [-n, n) raises IndexError (instead of corrupting
+    memory, which is what the raw C kernels would do)."""
     idx = np.ascontiguousarray(idx, dtype=np.int64)
-    if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= n):
-        raise IndexError(f"{name} out of range for store of {n} rows")
+    if idx.size:
+        lo, hi = int(idx.min()), int(idx.max())
+        if lo < -n or hi >= n:
+            raise IndexError(f"{name} out of range for store of {n} rows")
+        if lo < 0:
+            idx = np.where(idx < 0, idx + n, idx)
     return idx
 
 

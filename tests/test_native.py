@@ -77,16 +77,20 @@ def test_gather_rejects_wrong_scale_shape():
 
 
 def test_native_bounds_check_raises_indexerror():
-    """Out-of-range indices must raise (like the NumPy fallback), never
-    touch memory."""
+    """Out-of-range indices must raise (exactly like NumPy), never touch
+    memory; in-range negatives wrap exactly like NumPy."""
     rng = np.random.default_rng(5)
     store = _random_store(rng)
+    n = store.shape[0]
     with pytest.raises(IndexError):
-        native.gather_rows(store, np.array([store.shape[0]]))
+        native.gather_rows(store, np.array([n]))
     with pytest.raises(IndexError):
-        native.gather_scale_f32(store, np.array([-1]), np.ones(store.shape[1], np.float32))
+        native.gather_scale_f32(store, np.array([-(n + 1)]), np.ones(store.shape[1], np.float32))
     with pytest.raises(IndexError):
-        native.scatter_rows(store, np.array([store.shape[0] + 3]), store[:1].copy())
+        native.scatter_rows(store, np.array([n + 3]), store[:1].copy())
+    # NumPy-style wrap of in-range negatives
+    out = native.gather_rows(store, np.array([-1, -n]))
+    assert np.array_equal(out.view(np.uint16), store[[-1, -n]].view(np.uint16))
 
 
 def test_gather_scale_rejects_float16():
