@@ -3,10 +3,12 @@
 Re-implements the reference ``Buffer`` (reference ``buffer.py:7-125``) with a
 TPU-native split of responsibilities:
 
-- **Harvest on device**: both models' residual streams at the hook point(s)
-  come from the jitted :func:`crosscoder_tpu.models.lm.run_with_cache`
-  forward (replacing TransformerLens ``run_with_cache``, reference
-  ``buffer.py:81-89``), batch-shardable over the mesh ``data`` axis.
+- **Harvest on device**: all models' residual streams at the hook point(s)
+  come from ONE jitted :func:`crosscoder_tpu.models.lm.run_with_cache_multi`
+  dispatch per chunk, truncated at the highest hooked layer (replacing the
+  reference's per-model full-depth TransformerLens ``run_with_cache``,
+  reference ``buffer.py:81-89``), batch-shardable over the mesh ``data``
+  axis.
 - **Buffer + shuffle on host**: the replay store is host RAM (bf16 numpy),
   not HBM — the reference burns ~4.8 GB of GPU memory on it (reference
   ``buffer.py:18-22``). Instead of physically permuting 4.8 GB every refresh
@@ -70,9 +72,22 @@ class PairedActivationBuffer:
         token batches (mesh ``data`` axis; component N5).
     """
 
-    # harvest chunks kept in flight during refresh: device compute overlaps
-    # host fetch+scatter (1 = fully serial, the reference's behavior)
+    # harvest chunks kept in flight during refresh/calibration: device
+    # compute overlaps host fetch+scatter (1 = fully serial, the
+    # reference's behavior)
     PIPELINE_DEPTH = 3
+
+    def _pipelined(self, produced, drain) -> None:
+        """Drive ``produced`` (an iterator of dispatched device work) with a
+        bounded in-flight window, calling ``drain`` on each item in FIFO
+        order — the harvest pipeline shared by refresh and calibration."""
+        inflight: list = []
+        for item in produced:
+            inflight.append(item)
+            if len(inflight) >= self.PIPELINE_DEPTH:
+                drain(inflight.pop(0))
+        for item in inflight:
+            drain(item)
 
     def __init__(
         self,
@@ -153,11 +168,10 @@ class PairedActivationBuffer:
         tok = jnp.asarray(padded_tokens)
         if self.batch_sharding is not None:
             tok = jax.device_put(tok, self.batch_sharding)
-        per_source = []
-        for params in self.model_params:
-            cache = lm.run_with_cache(params, tok, self.lm_cfg, self.hook_points)
-            per_source.extend(cache[hp] for hp in self.hook_points)
-        return jnp.stack(per_source, axis=2).astype(jnp.bfloat16)
+        stacked = lm.run_with_cache_multi(
+            self.model_params, tok, self.lm_cfg, self.hook_points
+        )
+        return stacked.astype(jnp.bfloat16)
 
     def _harvest(self, token_batch: np.ndarray) -> np.ndarray:
         """Blocking harvest of one (possibly ragged) chunk → host array."""
@@ -193,16 +207,20 @@ class PairedActivationBuffer:
         # HBM with queued activation intermediates)
         sums = np.zeros((cfg.n_sources,), np.float64)
         count = 0
-        inflight: list = []
-        for start in range(0, n_seqs, self._chunk_seqs):
-            chunk = self.tokens[start: start + self._chunk_seqs][:n_seqs - start]
-            padded, n = self._pad_chunk(chunk)
-            inflight.append(chunk_norm_sums(self._harvest_dev(padded), jnp.int32(n)))
-            count += n * chunk.shape[1]
-            if len(inflight) >= self.PIPELINE_DEPTH:
-                sums += np.asarray(jax.device_get(inflight.pop(0)), np.float64)
-        for part in inflight:
+
+        def produced():
+            nonlocal count
+            for start in range(0, n_seqs, self._chunk_seqs):
+                chunk = self.tokens[start: start + self._chunk_seqs][:n_seqs - start]
+                padded, n = self._pad_chunk(chunk)
+                count += n * chunk.shape[1]
+                yield chunk_norm_sums(self._harvest_dev(padded), jnp.int32(n))
+
+        def drain(part) -> None:
+            nonlocal sums
             sums += np.asarray(jax.device_get(part), np.float64)
+
+        self._pipelined(produced(), drain)
         mean_norm = sums / max(count, 1)
         return (np.sqrt(cfg.d_in) / mean_norm).astype(np.float32)
 
@@ -233,22 +251,27 @@ class PairedActivationBuffer:
             return rows.shape[0]
 
         # Pipelined harvest: keep a few chunks' forwards in flight so device
-        # compute overlaps the host-side fetch + scatter (the device_get here
-        # is the only sync point; issuing it per-chunk serially would pay a
-        # full round trip per chunk on remote-tunnel TPU clients).
-        inflight: list = []
-        depth = self.PIPELINE_DEPTH
+        # compute overlaps the host-side fetch + scatter (the device_get in
+        # drain is the only sync point; issuing it per-chunk serially would
+        # pay a full round trip per chunk on remote-tunnel TPU clients).
         drained = 0
-        for start in range(0, num_batches, self._chunk_seqs):
-            stop = min(start + self._chunk_seqs, num_batches)
-            n_seqs = stop - start
-            seq_globals = self._global_seq + np.arange(n_seqs)
-            padded, n = self._pad_chunk(self._take_tokens(n_seqs))
-            inflight.append((self._harvest_dev(padded), n, seq_globals, write))
-            write += n * rows_per_seq
-            if len(inflight) >= depth:
-                drained += drain(inflight.pop(0))
-        drained += sum(drain(item) for item in inflight)
+
+        def produced():
+            nonlocal write
+            for start in range(0, num_batches, self._chunk_seqs):
+                stop = min(start + self._chunk_seqs, num_batches)
+                n_seqs = stop - start
+                seq_globals = self._global_seq + np.arange(n_seqs)
+                padded, n = self._pad_chunk(self._take_tokens(n_seqs))
+                item = (self._harvest_dev(padded), n, seq_globals, write)
+                write += n * rows_per_seq
+                yield item
+
+        def drain_count(item) -> None:
+            nonlocal drained
+            drained += drain(item)
+
+        self._pipelined(produced(), drain_count)
         assert drained == write == num_batches * rows_per_seq
         self._perm = self._rng.permutation(self.buffer_size)
         self.pointer = 0

@@ -334,7 +334,9 @@ def _hook_layers(cfg: LMConfig, hook_points: Sequence[str]) -> tuple[int, ...]:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "capture", "edit_fns", "edit_layers", "return_logits"),
+    static_argnames=(
+        "cfg", "capture", "edit_fns", "edit_layers", "return_logits", "n_scan"
+    ),
 )
 def _forward_impl(
     params: LMParams,
@@ -345,10 +347,13 @@ def _forward_impl(
     edit_layers: tuple[int, ...],
     edit_values: tuple[jax.Array, ...],
     return_logits: bool,
+    n_scan: int | None = None,
 ):
     B, S = tokens.shape
     D = cfg.d_model
     dt = dtype_of(cfg.dtype)
+    if n_scan is None:
+        n_scan = cfg.n_layers
 
     resid = params["embed"][tokens].astype(dt) * jnp.asarray(math.sqrt(D), dt)
 
@@ -363,8 +368,12 @@ def _forward_impl(
             resid = jnp.where(edit_arr[j] == i, edited, resid)
         return resid
 
-    stacked = params["layers"]
-    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    # TransformerLens-style stop_at_layer: scan only the blocks below the
+    # highest needed layer (the reference harvests with FULL forwards even
+    # for a mid-stack hook — reference buffer.py:81-89 — wasting every layer
+    # above it; at blocks.14 of 26 that is ~46% of the forward FLOPs)
+    stacked = jax.tree_util.tree_map(lambda x: x[:n_scan], params["layers"])
+    layer_ids = jnp.arange(n_scan, dtype=jnp.int32)
 
     def body(carry, xs):
         resid, buf = carry
@@ -376,9 +385,10 @@ def _forward_impl(
         return (resid, buf), None
 
     (resid, cap_buf), _ = jax.lax.scan(body, (resid, cap_buf), (stacked, layer_ids))
-    # virtual layer n_layers = final resid_post
-    resid = apply_hooks(resid, jnp.int32(cfg.n_layers))
-    cap_buf = _capture_into(cap_buf, resid, jnp.int32(cfg.n_layers), cap_arr)
+    # virtual layer n_scan: resid_pre of the first unscanned block (== final
+    # resid_post when n_scan == n_layers)
+    resid = apply_hooks(resid, jnp.int32(n_scan))
+    cap_buf = _capture_into(cap_buf, resid, jnp.int32(n_scan), cap_arr)
 
     logits = _unembed(params, resid, cfg) if return_logits else None
     return logits, cap_buf
@@ -415,8 +425,15 @@ def forward(
             if zeros is None:
                 zeros = jnp.zeros((tokens.shape[0], tokens.shape[1], cfg.d_model), dtype_of(cfg.dtype))
             values.append(zeros)
+    # without logits, nothing above the highest hooked layer is observable
+    n_scan = (
+        cfg.n_layers
+        if return_logits
+        else min(cfg.n_layers, max(cap_layers + edit_layers, default=0))
+    )
     logits, cap_buf = _forward_impl(
-        params, tokens, cfg, cap_layers, edit_fns, edit_layers, tuple(values), return_logits
+        params, tokens, cfg, cap_layers, edit_fns, edit_layers, tuple(values),
+        return_logits, n_scan=n_scan,
     )
     cache = {hp: cap_buf[i] for i, hp in enumerate(capture)}
     return logits, cache
@@ -437,6 +454,33 @@ def run_with_cache(
     """Capture-only forward (no unembedding) — the harvest primitive."""
     _, cache = forward(params, tokens, cfg, capture=hook_points, return_logits=False)
     return cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "capture"))
+def _multi_cache_impl(params_tuple, tokens, cfg: LMConfig, capture: tuple[str, ...]):
+    per_source = []
+    for p in params_tuple:
+        cache = run_with_cache(p, tokens, cfg, capture)
+        per_source.extend(cache[hp] for hp in capture)
+    return jnp.stack(per_source, axis=2)                   # [B, S, n_sources, D]
+
+
+def run_with_cache_multi(
+    params_seq: Sequence[LMParams],
+    tokens: jax.Array,
+    cfg: LMConfig,
+    hook_points: Sequence[str],
+) -> jax.Array:
+    """All models' captures in ONE compiled dispatch:
+    ``[B, S, n_models·n_hooks, d_model]``, source axis model-major.
+
+    The reference runs one ``run_with_cache`` per model per chunk (reference
+    ``buffer.py:81-89``) — two kernel launches and two host round trips where
+    one suffices; under a remote TPU client the fixed per-dispatch cost is
+    material (SURVEY.md §3.3 harvest path). Same architecture is required
+    (the reference's models share it by construction, train.py:45-55).
+    """
+    return _multi_cache_impl(tuple(params_seq), tokens, cfg, tuple(hook_points))
 
 
 def ce_loss(

@@ -170,3 +170,40 @@ def test_config_for_names():
     assert lm.config_for("gemma-2-2b-it") == cfg
     with pytest.raises(ValueError):
         lm.config_for("llama-3")
+
+
+def test_capture_truncated_scan_matches_full():
+    """run_with_cache stops at the highest hooked layer (stop_at_layer);
+    captures must equal the full forward's bitwise (same scan prefix)."""
+    cfg = lm.LMConfig.tiny()
+    params = lm.init_params(jax.random.key(5), cfg)
+    tokens = jax.numpy.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, size=(2, 16))
+    )
+    hooks = ["blocks.1.hook_resid_pre", "blocks.2.hook_resid_pre"]
+    cache_fast = lm.run_with_cache(params, tokens, cfg, hooks)
+    # force the full-depth path by also requesting logits
+    _, cache_full = lm.forward(params, tokens, cfg, capture=hooks, return_logits=True)
+    for hp in hooks:
+        np.testing.assert_array_equal(
+            np.asarray(cache_fast[hp], np.float32), np.asarray(cache_full[hp], np.float32)
+        )
+
+
+def test_run_with_cache_multi_matches_per_model():
+    """One-dispatch multi-model harvest == per-model run_with_cache, stacked
+    model-major (the buffer's source-axis contract)."""
+    cfg = lm.LMConfig.tiny()
+    pa = lm.init_params(jax.random.key(1), cfg)
+    pb = lm.init_params(jax.random.key(2), cfg)
+    tokens = jax.numpy.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab_size, size=(2, 12))
+    )
+    hooks = ("blocks.1.hook_resid_pre", "blocks.2.hook_resid_pre")
+    got = lm.run_with_cache_multi([pa, pb], tokens, cfg, hooks)
+    want = []
+    for p in (pa, pb):
+        cache = lm.run_with_cache(p, tokens, cfg, hooks)
+        want.extend(cache[hp] for hp in hooks)
+    want = jax.numpy.stack(want, axis=2)
+    np.testing.assert_array_equal(np.asarray(got, np.float32), np.asarray(want, np.float32))
