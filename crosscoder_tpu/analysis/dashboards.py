@@ -38,6 +38,7 @@ from crosscoder_tpu.analysis.plots import (
 from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.models import crosscoder as cc
 from crosscoder_tpu.models import lm
+from crosscoder_tpu.utils import pipeline
 
 
 @dataclass
@@ -87,22 +88,30 @@ class FeatureVisData:
         rel = np.asarray(dec_analysis.relative_norms(cc_params))[list(vis_cfg.features)]
         cos = np.asarray(dec_analysis.cosine_sims(cc_params))[list(vis_cfg.features)]
 
+        # params must be jit ARGUMENTS, not closed-over values — a closure
+        # bakes them into the program as constants (10.6 GB of captured
+        # constants for 2x Gemma-2-2B), which explodes lowering/compile
         @jax.jit
-        def latent_acts(tok: jax.Array) -> jax.Array:
-            caches = [
-                lm.run_with_cache(p, tok, lm_cfg, [vis_cfg.hook_point])[vis_cfg.hook_point]
-                for p in model_params
-            ]
-            x = jnp.stack(caches, axis=2)[:, 1:]            # drop BOS
-            f = cc.encode(cc_params, x.astype(jnp.float32), cc_cfg)
+        def _latent_acts(mparams, ccp, tok: jax.Array) -> jax.Array:
+            x = lm.run_with_cache_multi(mparams, tok, lm_cfg, (vis_cfg.hook_point,))
+            x = x[:, 1:]                                    # drop BOS
+            f = cc.encode(ccp, x.astype(jnp.float32), cc_cfg)
             return f[..., feats]                            # [B, S-1, n_feats]
+
+        def latent_acts(tok: jax.Array) -> jax.Array:
+            return _latent_acts(tuple(model_params), cc_params, tok)
 
         tokens = np.asarray(tokens)
         mb = vis_cfg.minibatch_size_tokens
-        all_acts = []
-        for start in range(0, tokens.shape[0], mb):
+        # keep a few minibatches' forwards in flight: fetching each result
+        # immediately would serialize a device round trip per minibatch
+        all_acts: list = []
+        pipeline.drive(
             # ragged tail included (one extra compile at most, no data dropped)
-            all_acts.append(np.asarray(latent_acts(jnp.asarray(tokens[start: start + mb]))))
+            (latent_acts(jnp.asarray(tokens[s: s + mb]))
+             for s in range(0, tokens.shape[0], mb)),
+            lambda a: all_acts.append(np.asarray(a)),
+        )
         acts = np.concatenate(all_acts)                     # [N, S-1, n_feats]
 
         out = []

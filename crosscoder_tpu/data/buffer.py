@@ -52,6 +52,7 @@ import numpy as np
 from crosscoder_tpu import native
 from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.models import lm
+from crosscoder_tpu.utils import pipeline
 
 _BF16 = np.dtype(jnp.bfloat16.dtype)
 
@@ -74,20 +75,11 @@ class PairedActivationBuffer:
 
     # harvest chunks kept in flight during refresh/calibration: device
     # compute overlaps host fetch+scatter (1 = fully serial, the
-    # reference's behavior)
-    PIPELINE_DEPTH = 3
+    # reference's behavior); see crosscoder_tpu.utils.pipeline
+    PIPELINE_DEPTH = pipeline.DEFAULT_DEPTH
 
     def _pipelined(self, produced, drain) -> None:
-        """Drive ``produced`` (an iterator of dispatched device work) with a
-        bounded in-flight window, calling ``drain`` on each item in FIFO
-        order — the harvest pipeline shared by refresh and calibration."""
-        inflight: list = []
-        for item in produced:
-            inflight.append(item)
-            if len(inflight) >= self.PIPELINE_DEPTH:
-                drain(inflight.pop(0))
-        for item in inflight:
-            drain(item)
+        pipeline.drive(produced, drain, depth=self.PIPELINE_DEPTH)
 
     def __init__(
         self,

@@ -1,0 +1,31 @@
+"""Bounded in-flight pipeline driver for device→host streaming loops.
+
+The recurring shape on a TPU host: dispatch device work chunk by chunk,
+fetch each result to host — but fetching immediately serializes a device
+round trip per chunk, and dispatching everything up front fills HBM with
+queued intermediates. The fix everywhere (buffer refresh, norm
+calibration, dashboard harvest) is the same bounded FIFO window.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, TypeVar
+
+T = TypeVar("T")
+
+# chunks kept in flight: device compute overlaps the host fetch/scatter of
+# earlier chunks (1 = fully serial)
+DEFAULT_DEPTH = 3
+
+
+def drive(produced: Iterable[T], drain: Callable[[T], None], depth: int = DEFAULT_DEPTH) -> None:
+    """Consume ``produced`` (an iterator that DISPATCHES device work as it
+    is advanced) keeping at most ``depth`` items in flight, calling
+    ``drain`` on each in FIFO order."""
+    inflight: list[T] = []
+    for item in produced:
+        inflight.append(item)
+        if len(inflight) >= depth:
+            drain(inflight.pop(0))
+    for item in inflight:
+        drain(item)
