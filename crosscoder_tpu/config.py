@@ -73,6 +73,9 @@ class CrossCoderConfig:
     hook_points: tuple[str, ...] = ()   # multi-layer crosscoder: several hooks per model
     activation: str = "relu"        # relu | topk | jumprelu | batchtopk
     topk_k: int = 32                # k for (batch)topk activation
+    sparse_decode: bool = False     # topk only: decode via the k active rows
+                                    # (gather + custom-vjp) instead of the
+                                    # dense [B,H]x[H,n,d] matmul
     jumprelu_theta: float = 0.001   # initial JumpReLU threshold
     jumprelu_bandwidth: float = 0.001  # STE bandwidth for the threshold gradient
     data_axis_size: int = -1        # -1: all remaining devices on the data axis
@@ -116,6 +119,10 @@ class CrossCoderConfig:
             raise ValueError(f"data_source must be 'gemma' or 'synthetic', got {self.data_source!r}")
         if self.master_dtype not in ("fp32", "bf16"):
             raise ValueError(f"master_dtype must be fp32 or bf16, got {self.master_dtype!r}")
+        if self.sparse_decode and self.activation != "topk":
+            raise ValueError(
+                f"sparse_decode requires activation='topk', got {self.activation!r}"
+            )
 
     # --- derived quantities -------------------------------------------------
     @property

@@ -328,7 +328,15 @@ class Trainer:
         ``step_time_ms`` (mean between logs, device-synced only at log
         points) rides along with every log record, and a non-empty
         ``cfg.profile_dir`` captures a ``jax.profiler`` device trace of
-        steps 10-14 for tensorboard/xprof."""
+        steps 10-14 for tensorboard/xprof.
+
+        Failure handling (SURVEY.md §5 "failure detection"): beyond the
+        reference's save-in-``finally`` (reference ``trainer.py:74-82``),
+        SIGTERM — the preemption notice on TPU VMs/pods — is caught for the
+        duration of the loop and triggers a clean stop: finish the current
+        step, write a resumable checkpoint, exit. A second SIGTERM falls
+        through to the previous handler."""
+        import signal
         import time
 
         num_steps = self.total_steps if num_steps is None else num_steps
@@ -337,8 +345,29 @@ class Trainer:
         progress = _progress_bar(start, num_steps)
         profiling = False
         last_log_t, last_log_i = time.perf_counter(), start
+
+        stop_requested = False
+        prev_handler = None
+
+        def _on_sigterm(signum, frame):
+            nonlocal stop_requested
+            if stop_requested:
+                # second signal: give control back — reinstall the previous
+                # disposition and re-raise so escalation actually escalates
+                signal.signal(signal.SIGTERM, prev_handler or signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+                return
+            stop_requested = True
+            print("[crosscoder_tpu] SIGTERM: stopping after this step, "
+                  "writing checkpoint", flush=True)
+
+        in_main_thread = threading.current_thread() is threading.main_thread()
+        if in_main_thread:
+            prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
         try:
             for i in progress:
+                if stop_requested:
+                    break
                 if self.cfg.profile_dir and i == start + 10:
                     jax.profiler.start_trace(self.cfg.profile_dir)
                     profiling = True
@@ -359,6 +388,8 @@ class Trainer:
                 if (i + 1) % self.cfg.save_every == 0:
                     self.save()
         finally:
+            if in_main_thread:
+                signal.signal(signal.SIGTERM, prev_handler or signal.SIG_DFL)
             if profiling:
                 jax.profiler.stop_trace()
             self.save()
