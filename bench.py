@@ -71,16 +71,23 @@ def main() -> None:
     key = jax.random.key(0)
     batches = [
         jax.device_put(
-            jax.random.normal(jax.random.fold_in(key, i), (cfg.batch_size, 2, cfg.d_in), dtype=jnp.bfloat16),
+            jax.random.normal(
+                jax.random.fold_in(key, i),
+                (cfg.batch_size, cfg.n_sources, cfg.d_in),
+                dtype=jnp.bfloat16,
+            ),
             batch_sh,
         )
         for i in range(4)
     ]
     # production serve path: raw bf16 rows + on-device per-source norm scale
+    # (length tracks cfg.n_sources so future configs can't shape-mismatch;
+    # 0.26 ≈ the Gemma-2-2B calibration factors, BASELINE.md)
     from jax.sharding import NamedSharding, PartitionSpec
 
     scale = jax.device_put(
-        jnp.asarray([0.276, 0.244], jnp.float32), NamedSharding(mesh, PartitionSpec())
+        jnp.full((cfg.n_sources,), 0.26, jnp.float32),
+        NamedSharding(mesh, PartitionSpec()),
     )
 
     # warmup / compile. NB: sync by FETCHING a scalar, not block_until_ready —

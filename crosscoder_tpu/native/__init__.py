@@ -38,9 +38,33 @@ _lock = threading.Lock()
 N_THREADS = max(1, min(8, (os.cpu_count() or 1) - 1))
 
 
+def _cpu_tag() -> str:
+    """Short hash of the CPU's ISA feature flags.
+
+    The .so is built with -march=native, so a cached artifact is only valid
+    on a CPU with the same feature set. On a shared tree (NFS home mounted
+    across heterogeneous hosts) the platform tag alone would let an older
+    CPU dlopen AVX-512 code and SIGILL mid-call, bypassing the graceful
+    NumPy fallback — keying the cache on the flags makes each host build
+    (or reuse) its own ISA-compatible binary instead.
+    """
+    import hashlib
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(flags.encode()).hexdigest()[:8]
+
+
 def _so_path() -> Path:
     tag = sysconfig.get_platform().replace("-", "_").replace(".", "_")
-    return _HERE / f"_hostops-{tag}.so"
+    return _HERE / f"_hostops-{tag}-{_cpu_tag()}.so"
 
 
 def _build(so: Path) -> None:

@@ -182,10 +182,18 @@ class Trainer:
         reference crosscoder.py:207-217)."""
         if self.checkpointer is None:
             raise ValueError("Trainer has no checkpointer to restore from")
-        self._drain_prefetch(discard=True)
+        # Quiesce the prefetch worker but don't discard its batch yet:
+        # whether that batch is stale depends on whether this checkpoint
+        # carries buffer stream state to rewind to. For a source without
+        # load_state_dict (any object with next() is allowed), the stream
+        # is NOT rewound, so discarding would silently skip one batch.
+        self._drain_prefetch()
         state, meta = self.checkpointer.restore(self.cfg, self._tx, version_dir, save)
         self.state = jax.device_put(state, self._state_shardings)
         if "buffer" in meta and hasattr(self.buffer, "load_state_dict"):
+            # the stream rewinds to the checkpoint position — the prefetched
+            # batch belongs to the abandoned position; now it is stale
+            self._drain_prefetch(discard=True)
             self.buffer.load_state_dict(meta["buffer"])
         elif hasattr(self.buffer, "ensure_filled"):
             # checkpoint carries no buffer state (foreign/weights-only save):

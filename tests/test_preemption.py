@@ -5,6 +5,7 @@ VMs/pods SIGTERM is the preemption notice)."""
 
 import json
 import os
+import selectors
 import signal
 import subprocess
 import sys
@@ -40,14 +41,27 @@ def test_sigterm_checkpoints_and_exits_cleanly(tmp_path):
         cwd=str(REPO),
     )
     # wait for the loop to actually start (skip warnings from jax import —
-    # stderr is merged into stdout)
+    # stderr is merged into stdout). The pipe read itself must be bounded:
+    # a child that hangs before printing anything would otherwise block
+    # this iteration forever and hang the suite instead of failing it.
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
     deadline = time.monotonic() + 120
-    for line in proc.stdout:
-        if line.strip() == "READY":
-            break
-        assert time.monotonic() < deadline, "child never reported READY"
-    else:
-        raise AssertionError("child exited before READY")
+    ready, pending = False, ""
+    while not ready:
+        remaining = deadline - time.monotonic()
+        # enforce the deadline even when the pipe keeps yielding non-READY
+        # chatter — select() returning ready must not bypass the timeout
+        if remaining <= 0 or not sel.select(timeout=remaining):
+            proc.kill()
+            proc.communicate()
+            raise AssertionError("child never reported READY within deadline")
+        chunk = os.read(proc.stdout.fileno(), 65536).decode(errors="replace")
+        if not chunk:
+            raise AssertionError("child exited before READY")
+        pending += chunk
+        ready = any(ln.strip() == "READY" for ln in pending.splitlines())
+    sel.close()
     time.sleep(3)  # let some steps run
     proc.send_signal(signal.SIGTERM)
     out, _ = proc.communicate(timeout=120)
