@@ -217,63 +217,150 @@ class PairedActivationBuffer:
         return (np.sqrt(cfg.d_in) / mean_norm).astype(np.float32)
 
     def refresh(self) -> None:
-        """Overwrite the rows just served with fresh activations, re-shuffle.
+        """Synchronous refill: first fill, resume, and tests.
 
         First call fills the whole buffer; later calls refill half (reference
-        ``buffer.py:70-74``). Fresh rows land on the *served* permutation
-        positions ``_perm[:n_new]`` — matching the reference, which serves
-        its shuffled buffer from row 0 and overwrites exactly that region
-        (reference ``buffer.py:98-113``): no row is served twice within a
-        fill, and unserved survivors are never discarded unseen.
+        ``buffer.py:70-74``). Steady-state training does NOT come through
+        here — the serve path refills *incrementally*, interleaving harvest
+        chunks between train steps (see :meth:`_advance_cycle`), so the
+        reference's multi-second stall every ~63 steps (reference
+        ``buffer.py:121-122``) becomes a sub-batch-sized bubble.
         """
-        cfg = self.cfg
         num_batches = self.buffer_batches if self.first else self.buffer_batches // 2
         self.first = False
+        self._begin_cycle(num_batches)
+        self._finish_cycle()
+
+    # -- incremental refill cycle ---------------------------------------
+    #
+    # One cycle = one reference refresh(): harvest `_cyc_batches` sequences,
+    # overwrite the permutation region `_perm[:target]`, re-shuffle, reset
+    # the read pointer. The reference runs the whole cycle as one blocking
+    # stall at the trigger point; here chunks are dispatched as the serve
+    # pointer frees their target positions, so the device interleaves
+    # harvest forwards with train steps and the trigger point only has to
+    # drain the (typically already-finished) last chunks.
+    #
+    # Write-safety invariant: a chunk's rows may land only on positions the
+    # current fill can no longer serve — either already-served slots
+    # (serve-order index < pointer) or the *statically unserved tail*: the
+    # trigger fires once pointer > buffer//2 − batch, i.e. after exactly
+    # m = floor((buffer//2 − batch)/batch) + 1 serves, so serve-order
+    # positions [m·batch, target) are provably never served this fill (the
+    # reference overwrites this same tail unseen, reference buffer.py:98-121).
+    # Writes go tail-first (rotation by `_cyc_rot`), then follow the pointer
+    # through the served prefix: a chunk at write offset w of r rows is safe
+    # once  w + r ≤ pointer + tail.
+
+    def _begin_cycle(self, num_batches: int | None = None) -> None:
+        rows_per_seq = self.cfg.seq_len - 1
+        # A forced refresh() mid-cycle abandons in-flight chunks; rewind the
+        # token stream over them so the sequences they harvested re-enter the
+        # new fill instead of silently never reaching the buffer.
+        inflight = getattr(self, "_cyc_inflight", None)
+        if inflight:
+            dropped = sum(item[1] for item in inflight)
+            self.token_pointer = (self.token_pointer - dropped) % self.tokens.shape[0]
+            self._global_seq -= dropped
+        if num_batches is None:
+            num_batches = self.buffer_batches // 2
+        b = self.cfg.batch_size
+        trigger = self.buffer_size // 2 - b
+        served_at_finish = (trigger // b + 1) * b
+        self._cyc_batches = num_batches
+        self._cyc_target = num_batches * rows_per_seq
+        # the tail rotation only applies to a cycle consumed incrementally
+        # (steady-state half refill); a full fill is synchronous and must
+        # keep the linear write order (store stays in harvest order)
+        if self._cyc_target > self.buffer_size // 2:
+            self._cyc_tail = 0
+        else:
+            self._cyc_tail = max(0, self._cyc_target - served_at_finish)
+        self._cyc_rot = served_at_finish if self._cyc_tail else 0
+        self._cyc_seq_done = 0          # sequences dispatched so far
+        self._cyc_write = 0             # rows dispatched so far
+        self._cyc_drained = 0           # rows landed in the store
+        self._cyc_inflight: list[tuple] = []
+
+    def _cyc_positions(self, woff: int, n_rows: int) -> np.ndarray:
+        """Store positions for cycle write offsets [woff, woff+n_rows):
+        serve-order index = rot + j for the tail writes, j − tail after."""
+        j = np.arange(woff, woff + n_rows)
+        order = np.where(j < self._cyc_tail, self._cyc_rot + j, j - self._cyc_tail)
+        return self._perm[order]
+
+    def _dispatch_chunk(self) -> None:
+        rows_per_seq = self.cfg.seq_len - 1
+        n_seqs = min(self._chunk_seqs, self._cyc_batches - self._cyc_seq_done)
+        seq_globals = self._global_seq + np.arange(n_seqs)
+        padded, n = self._pad_chunk(self._take_tokens(n_seqs))
+        self._cyc_inflight.append(
+            (self._harvest_dev(padded), n, seq_globals, self._cyc_write)
+        )
+        self._cyc_seq_done += n_seqs
+        self._cyc_write += n_seqs * rows_per_seq
+
+    def _drain_one(self) -> None:
+        cfg = self.cfg
         rows_per_seq = cfg.seq_len - 1
-        write = 0
+        acts_dev, n, seq_globals, woff = self._cyc_inflight.pop(0)
+        acts = np.asarray(jax.device_get(acts_dev))[:n]
+        acts = acts[:, 1:]                              # drop BOS (buffer.py:93)
+        rows = acts.reshape(-1, cfg.n_sources, cfg.d_in)
+        positions = self._cyc_positions(woff, rows.shape[0])
+        native.scatter_rows(self._store, positions, rows)
+        self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
+        self._cyc_drained += rows.shape[0]
 
-        def drain(item) -> int:
-            acts_dev, n, seq_globals, woff = item
-            acts = np.asarray(jax.device_get(acts_dev))[:n]
-            acts = acts[:, 1:]                              # drop BOS (buffer.py:93)
-            rows = acts.reshape(-1, cfg.n_sources, cfg.d_in)
-            positions = self._perm[woff: woff + rows.shape[0]]
-            native.scatter_rows(self._store, positions, rows)
-            self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
-            return rows.shape[0]
+    def _advance_cycle(self) -> None:
+        """Dispatch any harvest chunks whose target positions the serve
+        pointer has freed; fetch+scatter aged/finished ones. Called after
+        every served batch — this is where the refresh work actually
+        happens in steady state, a chunk or so per train step."""
+        rows_per_seq = self.cfg.seq_len - 1
+        budget = self.pointer + self._cyc_tail
+        while self._cyc_seq_done < self._cyc_batches:
+            next_rows = min(self._chunk_seqs, self._cyc_batches - self._cyc_seq_done) * rows_per_seq
+            if self._cyc_write + next_rows > budget:
+                break
+            self._dispatch_chunk()
+            while len(self._cyc_inflight) >= self.PIPELINE_DEPTH:
+                self._drain_one()
+        # opportunistically land chunks the device already finished, so the
+        # trigger point finds (almost) nothing left to wait for
+        while len(self._cyc_inflight) > 1:
+            try:
+                ready = self._cyc_inflight[0][0].is_ready()
+            except Exception:
+                break
+            if not ready:
+                break
+            self._drain_one()
 
-        # Pipelined harvest: keep a few chunks' forwards in flight so device
-        # compute overlaps the host-side fetch + scatter (the device_get in
-        # drain is the only sync point; issuing it per-chunk serially would
-        # pay a full round trip per chunk on remote-tunnel TPU clients).
-        drained = 0
-
-        def produced():
-            nonlocal write
-            for start in range(0, num_batches, self._chunk_seqs):
-                stop = min(start + self._chunk_seqs, num_batches)
-                n_seqs = stop - start
-                seq_globals = self._global_seq + np.arange(n_seqs)
-                padded, n = self._pad_chunk(self._take_tokens(n_seqs))
-                item = (self._harvest_dev(padded), n, seq_globals, write)
-                write += n * rows_per_seq
-                yield item
-
-        def drain_count(item) -> None:
-            nonlocal drained
-            drained += drain(item)
-
-        self._pipelined(produced(), drain_count)
-        assert drained == write == num_batches * rows_per_seq
+    def _finish_cycle(self) -> None:
+        """Complete the cycle: dispatch the remainder (none in steady
+        state), land everything, re-shuffle, reset the read pointer."""
+        while self._cyc_seq_done < self._cyc_batches:
+            self._dispatch_chunk()
+            while len(self._cyc_inflight) >= self.PIPELINE_DEPTH:
+                self._drain_one()
+        while self._cyc_inflight:
+            self._drain_one()
+        assert self._cyc_drained == self._cyc_write == self._cyc_target
         self._perm = self._rng.permutation(self.buffer_size)
         self.pointer = 0
         self._filled = True
         # suffix-min of source provenance in serve order: makes the per-step
         # stream snapshot (state_dict) O(1) instead of an O(buffer_size)
-        # min over the unserved tail on the hot serve path
+        # min over the unserved tail on the hot serve path. Mid-cycle
+        # incremental writes never touch the unserved survivor region (the
+        # write-safety invariant above), so this stays valid between fills;
+        # tail writes can only make it conservative (older), which is the
+        # safe direction for resume.
         self._suffix_min_src = np.minimum.accumulate(
             self._src_global[self._perm][::-1]
         )[::-1]
+        self._begin_cycle()
 
     def _take_tokens(self, n: int) -> np.ndarray:
         """Next ``n`` sequences, wrapping at the end of the corpus (the
@@ -306,8 +393,7 @@ class PairedActivationBuffer:
         available (:mod:`crosscoder_tpu.native`)."""
         idx = self._next_idx()
         out = native.gather_scale_f32(self._store, idx, self.normalisation_factor)
-        if self.pointer > self.buffer_size // 2 - self.cfg.batch_size:
-            self.refresh()                                   # buffer.py:121-122
+        self._after_serve()
         return out
 
     def next_raw(self) -> np.ndarray:
@@ -322,9 +408,17 @@ class PairedActivationBuffer:
         """
         idx = self._next_idx()
         out = native.gather_rows(self._store, idx)
-        if self.pointer > self.buffer_size // 2 - self.cfg.batch_size:
-            self.refresh()                                   # buffer.py:121-122
+        self._after_serve()
         return out
+
+    def _after_serve(self) -> None:
+        """Post-serve bookkeeping: interleave refill work, and complete the
+        cycle at the reference's trigger point (reference ``buffer.py:121``)
+        — by which time the incremental dispatches have already landed
+        nearly all of it."""
+        self._advance_cycle()
+        if self.pointer > self.buffer_size // 2 - self.cfg.batch_size:
+            self._finish_cycle()
 
     # ------------------------------------------------------------------
     # resume support (no reference counterpart)

@@ -94,34 +94,84 @@ def test_next_shape_dtype_and_scaling(lm_pair, tokens):
 
 
 def test_refresh_cadence_and_half_refill(lm_pair, tokens):
-    """Refresh fires when the pointer passes buffer//2 − batch (reference
-    buffer.py:121); later refreshes harvest only half the seqs (buffer.py:70-74),
-    overwrite exactly the served permutation positions (reference
-    buffer.py:98-113 serves row 0.. and overwrites that region), and leave
-    unserved survivors untouched."""
+    """The refill cycle completes at the reference's trigger point (pointer
+    passes buffer//2 − batch, reference buffer.py:121) and harvests half the
+    seqs per cycle (buffer.py:70-74) — but the harvest itself now runs
+    INCREMENTALLY between serves (chunks land on already-served permutation
+    slots), so the trigger point only drains stragglers and re-shuffles
+    instead of stalling for the whole half-buffer harvest."""
     lm_cfg, params = lm_pair
     b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
     assert b.token_pointer == 64
     perm_before = b._perm.copy()
     store_before = b._store.copy()
-    steps = 0
-    while b.token_pointer == 64:                 # serve until refresh fires
-        served_end = b.pointer + 32
+    served = []
+    for steps in range(1, 17):
+        served.append(b._perm[b.pointer: b.pointer + 32].copy())
         b.next()
-        steps += 1
-        assert steps < 100
-    # refresh threshold: pointer > 512 − 32 ⇒ after 16 serves of 32 rows
-    assert steps == 16
-    assert b.token_pointer == 64 + 32            # half refill: 32 more seqs
+        if steps < 16:
+            assert b.pointer == 32 * steps       # cycle not finished yet
+    # trigger: after 16 serves of 32 rows the pointer passed 512 − 32
     assert b.pointer == 0
+    assert b.token_pointer == 64 + 32            # half refill: 32 more seqs
     # unserved survivors (old perm tail) are byte-identical; the served
     # region was refilled with fresh rows
     survivors = perm_before[512:]
     np.testing.assert_array_equal(b._store[survivors], store_before[survivors])
     refilled = perm_before[:512]
     assert not np.array_equal(b._store[refilled], store_before[refilled])
-    # no row served twice: every served position lies in the refilled region
-    assert set(perm_before[:served_end]) <= set(refilled)
+    # no row served twice within the fill; every served position lies in
+    # the refilled region
+    served = np.concatenate(served)
+    assert len(np.unique(served)) == len(served)
+    assert set(served) <= set(refilled)
+
+
+@pytest.mark.parametrize("buffer_mult", [32, 33])
+def test_incremental_refill_never_corrupts_served_stream(lm_pair, tokens, buffer_mult):
+    """The overlap invariant: harvest chunks written mid-cycle may only land
+    on slots this fill can no longer serve, so every batch served during a
+    fill is byte-identical to the store content AT fill time — the stream is
+    exactly what a synchronous refresh would have served. Also probes that
+    the harvest really is interleaved (token pointer advances mid-cycle,
+    not in one stall at the trigger).
+
+    buffer_mult=32 gives _cyc_tail == 0 (refill exactly covers the served
+    region); 33 gives a buffer whose half-refill target exceeds the rows
+    served by trigger time (_cyc_tail == 16), exercising the tail-rotation
+    write path the production geometry hits (tail 3,840 at reference cfg)."""
+    lm_cfg, params = lm_pair
+    cfg = make_cfg(buffer_mult=buffer_mult)
+    b = PairedActivationBuffer(cfg, lm_cfg, params, tokens)
+    if buffer_mult == 33:
+        assert b._cyc_tail > 0, "geometry no longer exercises the tail path"
+    n_serve = (b.buffer_size // 2 - 32) // 32 + 1
+    start_tp = b.token_pointer
+    for cycle in range(2):                       # first and a survivor cycle
+        snap = b._store.copy()
+        perm = b._perm.copy()
+        scale = b.normalisation_factor[None, :, None]
+        for k in range(n_serve):
+            want = snap[perm[32 * k: 32 * k + 32]].astype(np.float32) * scale
+            got = b.next()
+            assert np.array_equal(got, want), (cycle, k)
+            if k == n_serve - 2:
+                assert b.token_pointer != (start_tp + cycle * b.buffer_batches // 2) % 256, \
+                    "harvest was not interleaved with serving"
+
+
+def test_forced_refresh_mid_cycle_rewinds_inflight_tokens(lm_pair, tokens):
+    """A public refresh() while the incremental cycle has dispatched-but-
+    unlanded chunks must rewind the token stream over them — otherwise those
+    sequences would never enter the buffer (silent data gap)."""
+    lm_cfg, params = lm_pair
+    b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    for _ in range(6):                           # mid-cycle; harvest underway
+        b.next()
+    inflight_seqs = sum(item[1] for item in b._cyc_inflight)
+    tp = b.token_pointer
+    b.refresh()                                  # forced half refill
+    assert b.token_pointer == (tp - inflight_seqs + 32) % 256
 
 
 def test_lazy_buffer_defers_harvest(lm_pair, tokens):
@@ -210,7 +260,7 @@ def test_token_wraparound(lm_pair, tokens):
     lm_cfg, params = lm_pair
     b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens[:80])
     assert b.token_pointer == 64
-    while b.token_pointer == 64:
+    for _ in range(16):                          # one full refill cycle
         b.next()
     assert b.token_pointer == (64 + 32) % 80
 
