@@ -254,14 +254,18 @@ class PairedActivationBuffer:
 
     def _begin_cycle(self, num_batches: int | None = None) -> None:
         rows_per_seq = self.cfg.seq_len - 1
-        # A forced refresh() mid-cycle abandons in-flight chunks; rewind the
-        # token stream over them so the sequences they harvested re-enter the
-        # new fill instead of silently never reaching the buffer.
-        inflight = getattr(self, "_cyc_inflight", None)
-        if inflight:
-            dropped = sum(item[1] for item in inflight)
+        # A forced refresh() mid-cycle abandons the whole unfinished cycle.
+        # NOTHING dispatched this cycle has been served yet (chunks land only
+        # on already-served or never-served-this-fill slots, and become
+        # servable only after _finish_cycle's reshuffle), so rewind the token
+        # stream over every dispatched sequence — in-flight AND drained —
+        # or those sequences would be harvested, overwritten, and never seen.
+        # A completed cycle zeroes _cyc_seq_done before calling here.
+        dropped = getattr(self, "_cyc_seq_done", 0)
+        if dropped:
             self.token_pointer = (self.token_pointer - dropped) % self.tokens.shape[0]
             self._global_seq -= dropped
+            self._cyc_inflight = []
         if num_batches is None:
             num_batches = self.buffer_batches // 2
         b = self.cfg.batch_size
@@ -347,6 +351,7 @@ class PairedActivationBuffer:
         while self._cyc_inflight:
             self._drain_one()
         assert self._cyc_drained == self._cyc_write == self._cyc_target
+        self._cyc_seq_done = 0      # cycle consumed: nothing left to abandon
         self._perm = self._rng.permutation(self.buffer_size)
         self.pointer = 0
         self._filled = True
@@ -447,6 +452,15 @@ class PairedActivationBuffer:
         }
 
     def load_state_dict(self, state: dict[str, Any]) -> None:
+        # the restored stream position supersedes any live cycle: drop its
+        # chunks WITHOUT the abandon-rewind (that would shift the restored
+        # pointer by sequences belonging to the pre-restore stream)
+        self._cyc_inflight = []
+        self._cyc_seq_done = 0
+        # restore must be independent of pre-restore buffer history: reset
+        # the permutation so the refill lands rows in harvest order, exactly
+        # as a freshly-constructed buffer's restore does (determinism A2)
+        self._perm = np.arange(self.buffer_size)
         self.token_pointer = int(state["token_pointer"])
         self._global_seq = self.token_pointer
         self._rng.bit_generator.state = state["rng_state"]

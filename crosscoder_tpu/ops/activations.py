@@ -69,18 +69,54 @@ def _topk_dense(h: jax.Array, k: int) -> jax.Array:
 
 def batchtopk(h: jax.Array, k: int) -> jax.Array:
     """TopK over the flattened (batch × d_hidden) pre-acts, keeping
-    ``k · batch`` entries globally; at eval time this behaves like a global
-    threshold (BatchTopK, Bussmann et al. 2024)."""
+    ``k · batch`` entries globally (ties at the threshold all kept); at eval
+    time this behaves like a global threshold (BatchTopK, Bussmann et al.
+    2024).
+
+    The global threshold — the (k·batch)-th largest ReLU'd value — is found
+    by exact bit-pattern bisection (31 fused compare-and-count sweeps), not
+    by sorting: ``lax.top_k`` over the flattened array is a 134M-element
+    device sort at the production shape (4096 × 2^15) that XLA cannot tile,
+    while each bisection sweep is a plain elementwise-compare + sum
+    reduction that fuses and scales to any size.
+    """
     hp = relu(h)
     n_rows = 1
     for s in hp.shape[:-1]:
         n_rows *= s
-    flat = hp.reshape(-1)
-    kk = min(k * n_rows, flat.shape[0])
-    vals = jax.lax.top_k(flat, kk)[0]
-    thresh = vals[-1]
+    kk = min(k * n_rows, hp.size)
+    thresh = _kth_largest_nonneg(hp, kk)
     mask = (hp >= thresh) & (hp > 0)
     return hp * jax.lax.stop_gradient(mask.astype(hp.dtype))
+
+
+def _kth_largest_nonneg(hp: jax.Array, kk: int) -> jax.Array:
+    """Exact k-th largest value of a non-negative array as an f32 scalar.
+
+    For non-negative IEEE-754 floats the int bit pattern is order-isomorphic
+    to the value, so binary search on the bit pattern converges to the exact
+    k-th order statistic in 31 steps; each step is one global
+    count-above-threshold reduction (the same trick as the Pallas TopK
+    kernel's per-row bisection, :mod:`crosscoder_tpu.ops.topk_pallas`).
+    Invariant: ``count(x >= lo) >= kk`` and ``count(x >= hi) < kk``.
+    """
+    hpf = hp.astype(jnp.float32)
+
+    def count_ge(bits: jax.Array) -> jax.Array:
+        v = jax.lax.bitcast_convert_type(bits, jnp.float32)
+        return jnp.sum((hpf >= v).astype(jnp.int32))
+
+    lo = jnp.int32(0)
+    hi = jax.lax.bitcast_convert_type(jnp.max(hpf), jnp.int32) + 1
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = lo + (hi - lo) // 2
+        ge_k = count_ge(mid) >= kk
+        return jnp.where(ge_k, mid, lo), jnp.where(ge_k, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, 31, body, (lo, hi))
+    return jax.lax.bitcast_convert_type(lo, jnp.float32).astype(hp.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

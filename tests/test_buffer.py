@@ -160,18 +160,47 @@ def test_incremental_refill_never_corrupts_served_stream(lm_pair, tokens, buffer
                     "harvest was not interleaved with serving"
 
 
-def test_forced_refresh_mid_cycle_rewinds_inflight_tokens(lm_pair, tokens):
-    """A public refresh() while the incremental cycle has dispatched-but-
-    unlanded chunks must rewind the token stream over them — otherwise those
-    sequences would never enter the buffer (silent data gap)."""
+def test_forced_refresh_mid_cycle_rewinds_all_dispatched_tokens(lm_pair, tokens):
+    """A public refresh() mid-cycle abandons the unfinished cycle. EVERY
+    sequence it dispatched — in-flight AND already drained into the store —
+    is unserved (cycle rows become servable only at _finish_cycle), so the
+    token stream must rewind over all of them or those sequences would be
+    harvested, overwritten, and never seen (silent data gap)."""
     lm_cfg, params = lm_pair
     b = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
     for _ in range(6):                           # mid-cycle; harvest underway
         b.next()
-    inflight_seqs = sum(item[1] for item in b._cyc_inflight)
+    dispatched = b._cyc_seq_done
+    drained = dispatched - sum(item[1] for item in b._cyc_inflight)
+    assert dispatched > 0 and drained > 0        # both kinds present mid-cycle
     tp = b.token_pointer
     b.refresh()                                  # forced half refill
-    assert b.token_pointer == (tp - inflight_seqs + 32) % 256
+    assert b.token_pointer == (tp - dispatched + 32) % 256
+
+
+def test_restore_on_live_buffer_keeps_checkpoint_position(lm_pair, tokens):
+    """load_state_dict() on a buffer that has been serving (Trainer.restore
+    path) must resume EXACTLY at the checkpoint's stream position — the
+    abandoned pre-restore cycle's chunks must not rewind the restored
+    pointer. The restored live buffer must equal a fresh-buffer restore."""
+    lm_cfg, params = lm_pair
+    donor = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    for _ in range(20):                          # crosses one refresh
+        donor.next()
+    state = donor.state_dict()
+
+    live = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    for _ in range(6):                           # live mid-cycle, chunks in flight
+        live.next()
+    assert live._cyc_seq_done > 0
+    live.load_state_dict(state)
+
+    fresh = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens, lazy=True)
+    fresh.load_state_dict(state)
+    assert live.token_pointer == fresh.token_pointer
+    np.testing.assert_array_equal(live._store, fresh._store)
+    for _ in range(3):
+        np.testing.assert_array_equal(live.next(), fresh.next())
 
 
 def test_lazy_buffer_defers_harvest(lm_pair, tokens):
