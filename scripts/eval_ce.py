@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""CE-recovered acceptance gate — the reference's only published-value
+quality metric (nb:cell 30: CE recovered 0.9219 base / 0.9258 IT on the
+published checkpoint), as a real CLI entry (the reference has it only as
+notebook cells 25-30).
+
+Modes
+-----
+published checkpoint + real Gemma-2-2B pair (needs network or a warm HF cache):
+
+    python scripts/eval_ce.py --hf --tokens data/tokens.npy --n-seqs 64
+
+a locally-trained checkpoint:
+
+    python scripts/eval_ce.py --version-dir checkpoints/version_0 \
+        --model-a google/gemma-2-2b --model-b google/gemma-2-2b-it \
+        --tokens data/tokens.npy
+
+air-gapped demonstration of the full gate (no downloads: trains a tiny
+deterministic LM pair on a synthetic language, harvests paired activations,
+trains a crosscoder on them, folds it, and runs the exact splicing eval):
+
+    python scripts/eval_ce.py --demo [--out artifacts/ce_gate.json]
+
+The demo is NOT the published-value comparison — it exercises every stage
+of the gate (harvest → train → fold → splice-eval) with real trained
+weights and checks recovered lands far above the zero-reconstruction floor
+and at/below the identity ceiling, machine-checked oracles included.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# published norm scaling factors for the published checkpoint (nb:cell 27)
+PUBLISHED_FACTORS = (0.2758961493232058, 0.24422852496546169)
+# published CE-recovered values (nb:cell 30, BASELINE.md)
+PUBLISHED_RECOVERED = {"A": 0.921875, "B": 0.92578125}
+
+
+def _load_tokens(path: str, n_seqs: int | None) -> np.ndarray:
+    if path.endswith(".pt"):
+        import torch
+
+        tok = torch.load(path, map_location="cpu").numpy()
+    else:
+        tok = np.load(path)
+    return tok[:n_seqs] if n_seqs else tok
+
+
+def run_real(args) -> dict:
+    """Gate against real LM weights + a real checkpoint (HF or local)."""
+    import jax.numpy as jnp
+
+    from crosscoder_tpu.analysis.ce_eval import (
+        crosscoder_reconstruct_fn,
+        get_ce_recovered_metrics,
+    )
+    from crosscoder_tpu.checkpoint import torch_compat
+    from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+    from crosscoder_tpu.models import crosscoder as cc
+    from crosscoder_tpu.models import lm
+
+    if args.hf:
+        params, cfg = torch_compat.load_from_hf()
+        factors = PUBLISHED_FACTORS
+    else:
+        params, cfg = Checkpointer.load_weights(args.version_dir, args.save)
+        factors = (
+            tuple(float(x) for x in args.norm_factors.split(","))
+            if args.norm_factors
+            else None
+        )
+        if factors is None:
+            raise SystemExit(
+                "--norm-factors a,b is required with --version-dir (the "
+                "factors the buffer calibrated during training; they are in "
+                "the run's logs / buffer state)"
+            )
+    folded = cc.fold_scaling_factors(params, jnp.asarray(factors, jnp.float32))
+
+    lm_cfg = lm.config_for(args.model_a)
+    pa, _ = lm.from_hf(args.model_a, lm_cfg)
+    pb, _ = lm.from_hf(args.model_b, lm_cfg)
+    tokens = _load_tokens(args.tokens, args.n_seqs)
+
+    metrics = get_ce_recovered_metrics(
+        tokens, lm_cfg, [pa, pb], cfg.hook_point,
+        crosscoder_reconstruct_fn(folded, cfg), chunk=args.chunk,
+    )
+    if args.hf:
+        metrics["published_recovered_A"] = PUBLISHED_RECOVERED["A"]
+        metrics["published_recovered_B"] = PUBLISHED_RECOVERED["B"]
+        metrics["gate_pass"] = bool(
+            abs(metrics["ce_recovered_A"] - PUBLISHED_RECOVERED["A"]) < 0.01
+            and abs(metrics["ce_recovered_B"] - PUBLISHED_RECOVERED["B"]) < 0.01
+        )
+    return metrics
+
+
+# ---------------------------------------------------------------------------
+# air-gapped demo gate
+
+
+def _train_tiny_lm(key, lm_cfg, tokens, steps: int, lr: float = 3e-3):
+    """Adam-train a tiny LM on the synthetic language until it beats the
+    uniform baseline by a wide margin (so zero-ablation has a real cost and
+    the recovered metric's denominator is meaningful)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from crosscoder_tpu.models import lm
+
+    if steps < 1:
+        raise SystemExit("--demo-lm-steps must be >= 1")
+    params = lm.init_params(key, lm_cfg)
+    tx = optax.adam(lr)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, tok):
+        def loss(p):
+            logits, _ = lm.forward(p, tok, lm_cfg)
+            return lm.loss_fn(logits, tok)
+
+        l, g = jax.value_and_grad(loss)(params)
+        upd, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, upd), opt, l
+
+    n = tokens.shape[0]
+    for i in range(steps):
+        batch = jnp.asarray(tokens[(i * 16) % n: (i * 16) % n + 16])
+        params, opt, l = step(params, opt, batch)
+    return params, float(l)
+
+
+def run_demo(args) -> dict:
+    """The full gate, air-gapped: synthetic language → two trained tiny LMs
+    → paired-activation harvest → crosscoder training → fold → splice eval,
+    plus the identity/zero oracle checks."""
+    import jax
+    import jax.numpy as jnp
+
+    from crosscoder_tpu.analysis.ce_eval import (
+        crosscoder_reconstruct_fn,
+        get_ce_recovered_metrics,
+    )
+    from crosscoder_tpu.config import CrossCoderConfig
+    from crosscoder_tpu.data.buffer import PairedActivationBuffer
+    from crosscoder_tpu.models import crosscoder as cc
+    from crosscoder_tpu.models import lm
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train.trainer import Trainer
+
+    # deterministic synthetic language: x_{t+1} = (5·x_t + 17) mod V with a
+    # random start token — fully predictable from the current token, so a
+    # tiny LM learns it and mid-stack ablation has a large, real CE cost
+    V, S, NSEQ = 257, 33, 512
+    rng = np.random.default_rng(11)
+    x0 = rng.integers(0, V, size=(NSEQ, 1))
+    tokens = np.zeros((NSEQ, S), dtype=np.int64)
+    tokens[:, :1] = x0
+    for t in range(1, S):
+        tokens[:, t] = (5 * tokens[:, t - 1] + 17) % V
+
+    lm_cfg = lm.LMConfig.tiny(vocab_size=V)
+    print("[demo] training tiny LM pair on the synthetic language ...")
+    pa, la = _train_tiny_lm(jax.random.key(0), lm_cfg, tokens, args.demo_lm_steps)
+    pb, lb = _train_tiny_lm(jax.random.key(1), lm_cfg, tokens, args.demo_lm_steps)
+    print(f"[demo] LM train CE: A={la:.3f} B={lb:.3f} (uniform={np.log(V):.3f})")
+
+    hook = "blocks.2.hook_resid_pre"
+    cfg = CrossCoderConfig(
+        d_in=lm_cfg.d_model, dict_size=1024, batch_size=256, buffer_mult=64,
+        seq_len=S, model_batch_size=16, norm_calib_batches=4,
+        hook_point=hook, num_tokens=256 * args.demo_cc_steps,
+        enc_dtype="fp32", l1_coeff=0.3, lr=1e-3, log_backend="null",
+        checkpoint_dir="", save_every=10**9,
+    )
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    buffer = PairedActivationBuffer(cfg, lm_cfg, [pa, pb], tokens)
+    print(f"[demo] training crosscoder for {cfg.total_steps} steps ...")
+    trainer = Trainer(cfg, buffer, mesh=mesh)
+    final = trainer.train()
+    print(f"[demo] crosscoder final: {final}")
+
+    params = jax.device_get(trainer.state.params)
+    folded = cc.fold_scaling_factors(
+        params, jnp.asarray(buffer.normalisation_factor)
+    )
+    eval_tokens = tokens[: args.n_seqs or 64]
+
+    print("[demo] oracle checks ...")
+    ident = get_ce_recovered_metrics(
+        eval_tokens, lm_cfg, [pa, pb], hook, lambda x: x, chunk=args.chunk
+    )
+    zero = get_ce_recovered_metrics(
+        eval_tokens, lm_cfg, [pa, pb], hook, jnp.zeros_like, chunk=args.chunk
+    )
+    metrics = get_ce_recovered_metrics(
+        eval_tokens, lm_cfg, [pa, pb], hook,
+        crosscoder_reconstruct_fn(folded, cfg), chunk=args.chunk,
+    )
+
+    out = {
+        "mode": "demo (air-gapped; synthetic-language LM pair, trained crosscoder)",
+        "lm_train_ce": {"A": la, "B": lb, "uniform": float(np.log(V))},
+        "crosscoder_final": {k: float(v) for k, v in final.items()},
+        **metrics,
+        "oracle_identity_recovered": {
+            "A": ident["ce_recovered_A"], "B": ident["ce_recovered_B"]
+        },
+        "oracle_zero_recovered": {
+            "A": zero["ce_recovered_A"], "B": zero["ce_recovered_B"]
+        },
+    }
+    ok = (
+        abs(out["oracle_identity_recovered"]["A"] - 1) < 1e-3
+        and abs(out["oracle_identity_recovered"]["B"] - 1) < 1e-3
+        # zero-recon is a FLOOR, not exactly 0: splice keeps BOS clean while
+        # zero-ablation zeros it too (the reference's hooks differ the same
+        # way, nb:cell 29), so it only approximates 0 — it must simply sit
+        # far below the trained crosscoder
+        and out["oracle_zero_recovered"]["A"] < 0.5
+        and out["oracle_zero_recovered"]["B"] < 0.5
+        and out["ce_recovered_A"] > 0.6
+        and out["ce_recovered_B"] > 0.6
+        and out["ce_recovered_A"] <= 1.005
+        and out["ce_recovered_B"] <= 1.005
+        # ablation must genuinely hurt, or "recovered" is vacuous (a
+        # near-perfect crosscoder can make ce_diff slightly NEGATIVE —
+        # reconstruction denoises — so only the denominator is gated)
+        and out["ce_zero_abl_A"] - out["ce_clean_A"] > 0.5
+        and out["ce_zero_abl_B"] - out["ce_clean_B"] > 0.5
+    )
+    out["gate_pass"] = bool(ok)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--hf", action="store_true", help="published HF checkpoint")
+    mode.add_argument("--version-dir", type=str, help="local checkpoint dir")
+    mode.add_argument("--demo", action="store_true", help="air-gapped gate demo")
+    ap.add_argument("--save", type=int, default=None)
+    ap.add_argument("--model-a", type=str, default="google/gemma-2-2b")
+    ap.add_argument("--model-b", type=str, default="google/gemma-2-2b-it")
+    ap.add_argument("--tokens", type=str, default=None, help=".npy or .pt token array")
+    ap.add_argument("--n-seqs", type=int, default=None)
+    ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--norm-factors", type=str, default=None, help="a,b fold factors")
+    ap.add_argument("--demo-lm-steps", type=int, default=400)
+    ap.add_argument("--demo-cc-steps", type=int, default=1500)
+    ap.add_argument("--out", type=str, default=None, help="write metrics JSON here")
+    ap.add_argument(
+        "--platform", type=str, default=None, choices=("cpu", "tpu"),
+        help="force a jax backend (default: cpu for --demo — its many tiny "
+        "compiles are faster locally than through a TPU tunnel — else the "
+        "platform default)",
+    )
+    args = ap.parse_args(argv)
+
+    platform = args.platform or ("cpu" if args.demo else None)
+    if platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    if not args.demo and not args.tokens:
+        ap.error("--tokens is required outside --demo mode")
+    metrics = run_demo(args) if args.demo else run_real(args)
+    print(json.dumps(metrics, indent=2))
+    if args.out:
+        Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.out).write_text(json.dumps(metrics, indent=2))
+        print(f"wrote {args.out}")
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -131,3 +131,34 @@ def test_ce_eval_ragged_tail_counts_all_sequences(eval_setup):
         assert a[f"ce_clean_{tag}"] == pytest.approx(b[f"ce_clean_{tag}"], abs=1e-4)
     with pytest.raises(ValueError):
         get_ce_recovered_metrics(tokens[:0], lm_cfg, params, HP, lambda x: x)
+
+
+def test_eval_ce_script_demo_smoke(tmp_path):
+    """scripts/eval_ce.py --demo end-to-end with tiny budgets: every stage
+    (LM pair training, harvest, crosscoder training, fold, splice eval,
+    oracles) runs and emits the full metric surface. Budgets are too small
+    for the quality gate itself — that's asserted by the default-budget run
+    recorded in artifacts/ce_gate_demo.json."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    out = tmp_path / "gate.json"
+    # subprocess, not in-process main(): --demo sets jax_platforms=cpu,
+    # a process-global backend choice that must not leak into (or be
+    # silently no-op'd by) this test session's already-initialized backend
+    script = Path(__file__).parent.parent / "scripts" / "eval_ce.py"
+    proc = subprocess.run(
+        [sys.executable, str(script), "--demo", "--demo-lm-steps", "30",
+         "--demo-cc-steps", "20", "--n-seqs", "8", "--out", str(out)],
+        capture_output=True, text=True, timeout=300,
+        cwd=Path(__file__).parent.parent,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    m = json.loads(out.read_text())
+    for tag in "AB":
+        for k in ("ce_clean", "ce_zero_abl", "ce_spliced", "ce_recovered"):
+            assert np.isfinite(m[f"{k}_{tag}"])
+    assert abs(m["oracle_identity_recovered"]["A"] - 1) < 1e-3
+    assert "gate_pass" in m
