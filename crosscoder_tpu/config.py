@@ -80,6 +80,12 @@ class CrossCoderConfig:
     jumprelu_bandwidth: float = 0.001  # STE bandwidth for the threshold gradient
     data_axis_size: int = -1        # -1: all remaining devices on the data axis
     model_axis_size: int = 1        # tensor-parallel shards of the dict axis
+    seq_shards: int = 0             # >0: harvest forwards shard the SEQUENCE
+                                    # axis over the mesh data axis (ring
+                                    # attention), for contexts too long for
+                                    # one chip; must equal the data-axis size
+                                    # and divide seq_len. 0 = batch-sharded
+                                    # harvest (default).
     grad_clip: float = 1.0          # reference hardcodes this (trainer.py:46)
     lr_decay_frac: float = 0.2      # linear lr decay over the last fraction (trainer.py:29-32)
     l1_warmup_frac: float = 0.05    # l1 warmup over the first fraction (trainer.py:36)
@@ -119,6 +125,12 @@ class CrossCoderConfig:
             raise ValueError(f"data_source must be 'gemma' or 'synthetic', got {self.data_source!r}")
         if self.master_dtype not in ("fp32", "bf16"):
             raise ValueError(f"master_dtype must be fp32 or bf16, got {self.master_dtype!r}")
+        if self.seq_shards < 0:
+            raise ValueError("seq_shards must be >= 0")
+        if self.seq_shards > 1 and self.seq_len % self.seq_shards != 0:
+            raise ValueError(
+                f"seq_shards {self.seq_shards} must divide seq_len {self.seq_len}"
+            )
         if self.sparse_decode and self.activation != "topk":
             raise ValueError(
                 f"sparse_decode requires activation='topk', got {self.activation!r}"

@@ -100,6 +100,24 @@ class PairedActivationBuffer:
             raise ValueError(f"tokens must be [n_seqs, {cfg.seq_len}], got {self.tokens.shape}")
         self.hook_points = cfg.resolved_hook_points()
         self.batch_sharding = batch_sharding
+        # sequence-parallel harvest (component N5 made reachable): shard the
+        # harvest forward's SEQUENCE axis over the mesh data axis — exact
+        # ring attention (parallel/ring_attention.py) — for contexts whose
+        # score matrix won't fit one chip. The replay/serve side is
+        # untouched: rows are rows regardless of how the forward was sharded.
+        self._seq_mesh = None
+        if cfg.seq_shards > 1:
+            if batch_sharding is None:
+                raise ValueError(
+                    "seq_shards needs a mesh: pass batch_sharding (its mesh's "
+                    "'data' axis is the sequence-shard axis)"
+                )
+            mesh_axis = int(batch_sharding.mesh.shape.get("data", 1))
+            if mesh_axis != cfg.seq_shards:
+                raise ValueError(
+                    f"seq_shards {cfg.seq_shards} != mesh data axis {mesh_axis}"
+                )
+            self._seq_mesh = batch_sharding.mesh
 
         rows_per_seq = cfg.seq_len - 1                      # BOS dropped
         # reference buffer.py:15-17: round the row budget down to whole seqs
@@ -124,9 +142,11 @@ class PairedActivationBuffer:
 
         # every harvest forward runs at this fixed sequence count: a multiple
         # of the mesh data-axis size (sharding divisibility) >= the requested
-        # model_batch_size — one compile shape, ragged tails padded
+        # model_batch_size — one compile shape, ragged tails padded. Under
+        # seq_shards the data axis carries the SEQUENCE, so the batch axis
+        # has no divisibility constraint.
         data_axis = 1
-        if batch_sharding is not None:
+        if batch_sharding is not None and self._seq_mesh is None:
             data_axis = int(batch_sharding.mesh.shape.get("data", 1))
         self._chunk_seqs = -(-cfg.model_batch_size // data_axis) * data_axis
 
@@ -158,11 +178,21 @@ class PairedActivationBuffer:
         several chunks' forwards against host-side fetch/scatter work.
         """
         tok = jnp.asarray(padded_tokens)
-        if self.batch_sharding is not None:
-            tok = jax.device_put(tok, self.batch_sharding)
-        stacked = lm.run_with_cache_multi(
-            self.model_params, tok, self.lm_cfg, self.hook_points
-        )
+        if self._seq_mesh is not None:
+            # sequence-sharded forwards (ring attention over the data axis),
+            # all models in ONE compiled dispatch; capture comes back
+            # globally stitched, same [C, S, n, d] shape and model-major
+            # source order as the dense path
+            stacked = lm.run_with_cache_multi_seq_parallel(
+                self.model_params, tok, self.lm_cfg, self.hook_points,
+                self._seq_mesh,
+            )
+        else:
+            if self.batch_sharding is not None:
+                tok = jax.device_put(tok, self.batch_sharding)
+            stacked = lm.run_with_cache_multi(
+                self.model_params, tok, self.lm_cfg, self.hook_points
+            )
         return stacked.astype(jnp.bfloat16)
 
     def _harvest(self, token_batch: np.ndarray) -> np.ndarray:

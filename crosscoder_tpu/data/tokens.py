@@ -27,22 +27,54 @@ import numpy as np
 from crosscoder_tpu.config import CrossCoderConfig
 
 
+def rechunk(tokens: np.ndarray, seq_len: int) -> np.ndarray:
+    """Reshape a pretokenized ``[n, w]`` corpus to width ``seq_len``.
+
+    The published corpus is pre-chunked at 1024 (documents were already
+    split arbitrarily at that width), so longer contexts are formed by
+    concatenating whole rows (``seq_len`` a multiple of ``w``; interior BOS
+    tokens ride along as ordinary tokens). Views only — mmap-friendly.
+
+    Splitting rows to SHORTER sequences is rejected: the tail pieces would
+    start with an ordinary mid-document token, not BOS — Gemma-2 activation
+    distributions shift without the BOS attention sink, and the buffer's
+    drop-BOS step (reference ``buffer.py:93``) would silently discard a
+    real content token. Re-tokenize at the shorter length instead.
+    """
+    w = tokens.shape[1]
+    if seq_len == w:
+        return tokens
+    if seq_len % w == 0:
+        f = seq_len // w
+        n = tokens.shape[0] // f * f
+        if n == 0:
+            raise ValueError(f"corpus has {tokens.shape[0]} rows of {w}; "
+                             f"cannot form one {seq_len}-token sequence")
+        return tokens[:n].reshape(-1, seq_len)
+    raise ValueError(
+        f"seq_len {seq_len} must be a multiple of the corpus width {w} "
+        f"(shorter lengths would produce BOS-less sequences; re-tokenize "
+        f"at {seq_len} instead)"
+    )
+
+
 def load_pile_lmsys_mixed_tokens(
     cfg: CrossCoderConfig, mmap: bool = True
 ) -> np.ndarray:
-    """Token matrix ``[n_seqs, seq_len] int32``."""
+    """Token matrix ``[n_seqs, cfg.seq_len] int32`` (re-chunked from the
+    corpus's native width when they differ — long-context harvest)."""
     name = cfg.dataset_name.split("/")[-1]
     data_dir = Path(cfg.data_dir)
     npy = data_dir / f"{name}.npy"
     if npy.exists():
-        return np.load(npy, mmap_mode="r" if mmap else None)
+        return rechunk(np.load(npy, mmap_mode="r" if mmap else None), cfg.seq_len)
 
     pt = data_dir / f"{name}.pt"
     if pt.exists():
         import torch  # the reference's cache format (utils.py:186)
 
         tokens = torch.load(pt, map_location="cpu").numpy()
-        return np.ascontiguousarray(tokens.astype(np.int32, copy=False))
+        return rechunk(np.ascontiguousarray(tokens.astype(np.int32, copy=False)), cfg.seq_len)
 
     print(f"[crosscoder_tpu] downloading {cfg.dataset_name} (first run only)")
     import datasets  # deferred: network path
@@ -53,4 +85,4 @@ def load_pile_lmsys_mixed_tokens(
     data_dir.mkdir(parents=True, exist_ok=True)
     np.save(npy, tokens)
     print(f"[crosscoder_tpu] cached {tokens.shape} tokens at {npy}")
-    return tokens
+    return rechunk(tokens, cfg.seq_len)

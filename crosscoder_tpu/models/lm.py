@@ -520,15 +520,78 @@ def forward_seq_parallel(
     Numerics are asserted equal to the dense forward by
     ``tests/test_ring_attention.py``.
     """
-    n = mesh.shape[axis_name]
-    S = tokens.shape[1]
-    if S % n != 0:
-        raise ValueError(f"seq len {S} not divisible by {n} sequence shards")
+    _check_seq_divisible(tokens, mesh, axis_name)
     cap_layers = _hook_layers(cfg, tuple(capture))
     fn = _seq_parallel_fn(cfg, mesh, axis_name, cap_layers, return_logits)
     logits, cap_buf = fn(params, tokens)
     cache = {hp: cap_buf[i] for i, hp in enumerate(capture)}
     return logits, cache
+
+
+def _check_seq_divisible(tokens: jax.Array, mesh, axis_name: str) -> None:
+    n = mesh.shape[axis_name]
+    if tokens.shape[1] % n != 0:
+        raise ValueError(
+            f"seq len {tokens.shape[1]} not divisible by {n} sequence shards"
+        )
+
+
+def _seq_local_body(
+    params, tok_local, cfg: LMConfig, axis_name: str, n: int,
+    cap_layers: tuple[int, ...], return_logits: bool,
+):
+    """Per-shard forward over the local sequence slice (shared by the
+    single-model and fused multi-model sequence-parallel entry points).
+
+    Mirrors ``_forward_impl``'s stop-at-layer: without logits, nothing above
+    the highest captured layer is observable, so the scan is truncated there
+    — at blocks.14 of Gemma-2-2B's 26 layers that is ~46% of the layer
+    FLOPs, and long-context harvest is exactly where it matters.
+    """
+    from crosscoder_tpu.parallel.ring_attention import ring_attention
+
+    dt = dtype_of(cfg.dtype)
+    n_cap = len(cap_layers)
+    scale = cfg.query_pre_attn_scalar ** -0.5
+    n_scan = cfg.n_layers if return_logits else min(
+        cfg.n_layers, max(cap_layers, default=0)
+    )
+
+    B, Sl = tok_local.shape
+    cap_arr = jnp.asarray(cap_layers, jnp.int32) if n_cap else None
+    idx = jax.lax.axis_index(axis_name)
+    pos = idx * Sl + jnp.arange(Sl)
+    resid = params["embed"][tok_local].astype(dt) * jnp.asarray(
+        math.sqrt(cfg.d_model), dt
+    )
+    buf = jnp.zeros((n_cap, B, Sl, cfg.d_model), dt) if n_cap else None
+
+    def body(carry, xs):
+        resid, buf = carry
+        lp, i = xs
+        buf = _capture_into(buf, resid, i, cap_arr)
+        is_local = (i % 2) == 0
+        xn = _rms_norm(resid, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(xn, lp, cfg, pos)
+        a = ring_attention(
+            q, k, v, axis_name=axis_name, n_shards=n, scale=scale,
+            softcap=cfg.attn_softcap, sliding_window=cfg.sliding_window,
+            is_local=is_local,
+        ).reshape(B, Sl, cfg.n_heads * cfg.head_dim)
+        a = jnp.einsum(
+            "bsq,qd->bsd", a, lp["wo"], preferred_element_type=jnp.float32
+        ).astype(dt)
+        resid = resid + _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+        mlp = _mlp(_rms_norm(resid, lp["pre_ffw_norm"], cfg.rms_eps), lp)
+        resid = resid + _rms_norm(mlp, lp["post_ffw_norm"], cfg.rms_eps)
+        return (resid, buf), None
+
+    stacked = jax.tree_util.tree_map(lambda x: x[:n_scan], params["layers"])
+    layer_ids = jnp.arange(n_scan, dtype=jnp.int32)
+    (resid, buf), _ = jax.lax.scan(body, (resid, buf), (stacked, layer_ids))
+    buf = _capture_into(buf, resid, jnp.int32(n_scan), cap_arr)
+    logits = _unembed(params, resid, cfg) if return_logits else None
+    return logits, buf
 
 
 @functools.lru_cache(maxsize=32)
@@ -541,48 +604,13 @@ def _seq_parallel_fn(
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from crosscoder_tpu.parallel.ring_attention import ring_attention
-
     n = mesh.shape[axis_name]
-    dt = dtype_of(cfg.dtype)
     n_cap = len(cap_layers)
-    scale = cfg.query_pre_attn_scalar ** -0.5
 
     def local_fn(params, tok_local):
-        B, Sl = tok_local.shape
-        cap_arr = jnp.asarray(cap_layers, jnp.int32) if n_cap else None
-        idx = jax.lax.axis_index(axis_name)
-        pos = idx * Sl + jnp.arange(Sl)
-        resid = params["embed"][tok_local].astype(dt) * jnp.asarray(
-            math.sqrt(cfg.d_model), dt
+        return _seq_local_body(
+            params, tok_local, cfg, axis_name, n, cap_layers, return_logits
         )
-        buf = jnp.zeros((n_cap, B, Sl, cfg.d_model), dt) if n_cap else None
-
-        def body(carry, xs):
-            resid, buf = carry
-            lp, i = xs
-            buf = _capture_into(buf, resid, i, cap_arr)
-            is_local = (i % 2) == 0
-            xn = _rms_norm(resid, lp["attn_norm"], cfg.rms_eps)
-            q, k, v = _qkv(xn, lp, cfg, pos)
-            a = ring_attention(
-                q, k, v, axis_name=axis_name, n_shards=n, scale=scale,
-                softcap=cfg.attn_softcap, sliding_window=cfg.sliding_window,
-                is_local=is_local,
-            ).reshape(B, Sl, cfg.n_heads * cfg.head_dim)
-            a = jnp.einsum(
-                "bsq,qd->bsd", a, lp["wo"], preferred_element_type=jnp.float32
-            ).astype(dt)
-            resid = resid + _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
-            mlp = _mlp(_rms_norm(resid, lp["pre_ffw_norm"], cfg.rms_eps), lp)
-            resid = resid + _rms_norm(mlp, lp["post_ffw_norm"], cfg.rms_eps)
-            return (resid, buf), None
-
-        layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-        (resid, buf), _ = jax.lax.scan(body, (resid, buf), (params["layers"], layer_ids))
-        buf = _capture_into(buf, resid, jnp.int32(cfg.n_layers), cap_arr)
-        logits = _unembed(params, resid, cfg) if return_logits else None
-        return logits, buf
 
     out_logits_spec = P(None, axis_name, None) if return_logits else P()
     out_cap_spec = P(None, None, axis_name, None) if n_cap else P()
@@ -593,6 +621,60 @@ def _seq_parallel_fn(
         out_specs=(out_logits_spec, out_cap_spec),
         check_vma=False,
     ))
+
+
+@functools.lru_cache(maxsize=32)
+def _seq_parallel_multi_fn(
+    cfg: LMConfig, mesh, axis_name: str, cap_layers: tuple[int, ...]
+):
+    """Fused multi-model sequence-parallel capture: ONE jitted shard_map
+    dispatch runs every model's truncated forward over the same local token
+    slice — the sequence-sharded analogue of ``_multi_cache_impl``, keeping
+    the per-dispatch fixed cost (material under a remote TPU client) at one
+    per chunk. (Kept separate from ``_seq_parallel_fn``: the out-tree is a
+    single stacked capture array, not the (logits, buffer) pair; the model
+    count keys the inner jit's retrace via the params-tuple length.)"""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis_name]
+
+    def local_fn(params_tuple, tok_local):
+        bufs = []
+        for p in params_tuple:
+            _, buf = _seq_local_body(
+                p, tok_local, cfg, axis_name, n, cap_layers, False
+            )
+            bufs.append(buf)                       # each [n_cap, B, Sl, D]
+        out = jnp.concatenate(bufs, axis=0)        # model-major sources
+        return jnp.transpose(out, (1, 2, 0, 3))    # [B, Sl, n_sources, D]
+
+    return jax.jit(shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name)),
+        out_specs=P(None, axis_name, None, None),
+        check_vma=False,
+    ))
+
+
+def run_with_cache_multi_seq_parallel(
+    params_seq: Sequence[LMParams],
+    tokens: jax.Array,
+    cfg: LMConfig,
+    hook_points: Sequence[str],
+    mesh,
+    *,
+    axis_name: str = "data",
+) -> jax.Array:
+    """All models' captures with the SEQUENCE axis sharded over ``axis_name``
+    (ring attention): ``[B, S, n_models·n_hooks, d_model]``, source axis
+    model-major — shape/order-compatible with :func:`run_with_cache_multi`,
+    in one compiled dispatch."""
+    _check_seq_divisible(tokens, mesh, axis_name)
+    cap_layers = _hook_layers(cfg, tuple(hook_points))
+    fn = _seq_parallel_multi_fn(cfg, mesh, axis_name, cap_layers)
+    return fn(tuple(params_seq), tokens)
 
 
 # ---------------------------------------------------------------------------

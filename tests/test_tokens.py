@@ -1,0 +1,39 @@
+"""Corpus loading: disk-cache round trip and seq-len re-chunking (the
+published corpus is pre-chunked at 1024; long-context harvest concatenates
+whole rows, reference utils.py:180-196 has no such path)."""
+
+import numpy as np
+import pytest
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data import tokens as tok_mod
+
+
+def test_rechunk_identity_and_views():
+    t = np.arange(6 * 8, dtype=np.int32).reshape(6, 8)
+    assert tok_mod.rechunk(t, 8) is t
+    # longer: concatenate whole rows, drop the ragged remainder
+    long = tok_mod.rechunk(t, 16)
+    assert long.shape == (3, 16)
+    np.testing.assert_array_equal(long[0], np.arange(16))
+
+
+def test_rechunk_incompatible():
+    t = np.zeros((4, 8), np.int32)
+    with pytest.raises(ValueError, match="must be a multiple"):
+        tok_mod.rechunk(t, 6)
+    # shorter sequences are rejected: the split tails would be BOS-less
+    with pytest.raises(ValueError, match="must be a multiple"):
+        tok_mod.rechunk(t, 4)
+    with pytest.raises(ValueError, match="cannot form"):
+        tok_mod.rechunk(t, 64)
+
+
+def test_npy_cache_roundtrip_with_rechunk(tmp_path):
+    corpus = np.arange(8 * 16, dtype=np.int32).reshape(8, 16)
+    cfg = CrossCoderConfig(data_dir=str(tmp_path), dataset_name="x/demo-corpus",
+                           seq_len=32, seq_shards=0)
+    np.save(tmp_path / "demo-corpus.npy", corpus)
+    out = tok_mod.load_pile_lmsys_mixed_tokens(cfg)
+    assert out.shape == (4, 32)
+    np.testing.assert_array_equal(out[0], np.arange(32))
