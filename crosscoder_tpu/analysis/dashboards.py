@@ -20,6 +20,7 @@ fall out. The crosscoder must be the FOLDED one if activations are raw
 
 from __future__ import annotations
 
+import functools
 import html as _html
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -50,6 +51,8 @@ class FeatureVisConfig:
     minibatch_size_tokens: int = 4       # sequences per harvest forward
     top_k_sequences: int = 8             # heatmap rows per feature
     window: int = 24                     # tokens shown around the peak
+    logit_lens_k: int = 10               # promoted/suppressed tokens per table
+    include_logit_lens: bool = True      # the fork's logit tables (nb:cells 33-42)
 
     def __post_init__(self) -> None:
         self.features = tuple(int(f) for f in self.features)
@@ -65,6 +68,57 @@ class FeatureData:
     acts_sample: np.ndarray              # nonzero activations (density plot)
     top_seqs: list[dict] = field(default_factory=list)
     # each: {tokens: [int], values: [float], peak: int}
+    logit_lens: list[dict] = field(default_factory=list)
+    # per source: {source: int, promoted: [(token_id, value)...],
+    #              suppressed: [(token_id, value)...]} — the sae_vis fork's
+    # top promoted/suppressed output-token tables (nb:cells 33-42)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _logit_lens_topk(w_sel: jax.Array, embed: jax.Array, w_final: jax.Array, k: int):
+    """Linear logit lens of decoder directions through ONE model's head:
+    direction → final-RMSNorm scale ``(1+w)`` → tied unembedding. Returns
+    (top values, top ids, bottom values, bottom ids), each ``[F, L, k]``.
+
+    The RMS normalization scalar and the final logit softcap are monotone
+    per position, so they cannot change the ranking; reported values are
+    the pre-softcap linear effects (the sae_vis fork's tables do the same
+    linear approximation)."""
+    dirs = w_sel.astype(jnp.float32) * (1.0 + w_final.astype(jnp.float32))
+    # fp32 ACCUMULATION, not an fp32 copy of the embedding (a 256k×2304
+    # bf16 embed would materialize ~2.4 GB per model as astype)
+    logits = jnp.einsum("fld,vd->flv", dirs, embed, preferred_element_type=jnp.float32)
+    top_v, top_i = jax.lax.top_k(logits, k)
+    bot_v, bot_i = jax.lax.top_k(-logits, k)
+    return top_v, top_i, -bot_v, bot_i
+
+
+def _compute_logit_lens(
+    cc_params: cc.Params,
+    cc_cfg: CrossCoderConfig,
+    model_params,
+    features: tuple[int, ...],
+    k: int,
+) -> list[list[dict]]:
+    """Per feature, per source: top-k promoted/suppressed output tokens —
+    the fork's feature-page logit tables (nb:cells 33-42), absent from the
+    round-1 dashboards (VERDICT missing #4)."""
+    n_hooks = cc_cfg.n_sources // cc_cfg.n_models
+    w_dec = jnp.asarray(cc_params["W_dec"])[jnp.asarray(features)]  # [F, n_src, d]
+    per_feature: list[list[dict]] = [[] for _ in features]
+    for m, p in enumerate(model_params):
+        sel = w_dec[:, m * n_hooks: (m + 1) * n_hooks]              # [F, L, d]
+        tv, ti, bv, bi = jax.device_get(
+            _logit_lens_topk(sel, p["embed"], p["final_norm"], k)
+        )
+        for fi in range(len(features)):
+            for li in range(n_hooks):
+                per_feature[fi].append({
+                    "source": m * n_hooks + li,
+                    "promoted": list(zip(ti[fi, li].tolist(), tv[fi, li].tolist())),
+                    "suppressed": list(zip(bi[fi, li].tolist(), bv[fi, li].tolist())),
+                })
+    return per_feature
 
 
 class FeatureVisData:
@@ -114,6 +168,13 @@ class FeatureVisData:
         )
         acts = np.concatenate(all_acts)                     # [N, S-1, n_feats]
 
+        lens_tables: list[list[dict]] = [[] for _ in vis_cfg.features]
+        if vis_cfg.include_logit_lens:
+            lens_tables = _compute_logit_lens(
+                cc_params, cc_cfg, model_params, vis_cfg.features,
+                vis_cfg.logit_lens_k,
+            )
+
         out = []
         for fi, feat in enumerate(vis_cfg.features):
             a = acts[..., fi]                               # [N, S-1]
@@ -141,6 +202,7 @@ class FeatureVisData:
                 cosine_sim=float(cos[fi]),
                 acts_sample=nz[:10_000],
                 top_seqs=seqs,
+                logit_lens=lens_tables[fi],
             ))
         return cls(vis_cfg, out)
 
@@ -162,6 +224,29 @@ class FeatureVisData:
             hist = (
                 svg_histogram(fd.acts_sample) if fd.acts_sample.size else "<i>never active</i>"
             )
+            lens_html = ""
+            if fd.logit_lens:
+                from crosscoder_tpu.utils.logging import source_tag
+
+                blocks = []
+                for tab in fd.logit_lens:
+                    # escape: a real tokenizer's decode can emit '<', '&', …
+                    pos = " ".join(
+                        f'<span class="tok plus">{_html.escape(render(t))}'
+                        f'<sub>{v:+.2f}</sub></span>'
+                        for t, v in tab["promoted"]
+                    )
+                    neg = " ".join(
+                        f'<span class="tok minus">{_html.escape(render(t))}'
+                        f'<sub>{v:+.2f}</sub></span>'
+                        for t, v in tab["suppressed"]
+                    )
+                    blocks.append(
+                        f'<div class="lens"><b>{source_tag(tab["source"])}</b>'
+                        f'<div>promoted: {pos}</div>'
+                        f'<div>suppressed: {neg}</div></div>'
+                    )
+                lens_html = f'<div class="lenses">{"".join(blocks)}</div>'
             cards.append(f"""
 <div class="card">
   <h2>feature {fd.feature}</h2>
@@ -172,6 +257,7 @@ class FeatureVisData:
         <td>dec cosine</td><td>{fd.cosine_sim:.3f}</td></tr>
   </table>
   <div class="hist">{hist}</div>
+  {lens_html}
   <div class="seqs">{"".join(rows) or "<i>no activating sequences in sample</i>"}</div>
 </div>""")
         doc = f"""<!doctype html><html><head><meta charset="utf-8">
@@ -183,6 +269,11 @@ class FeatureVisData:
  .seq {{ font-family: ui-monospace, monospace; font-size: 13px; margin: .35em 0;
          white-space: nowrap; overflow-x: auto; }}
  .peak {{ color: #888; font-size: 11px; }}
+ .lens {{ font-size: 12px; margin: .3em 0; }}
+ .lens .tok {{ font-family: ui-monospace, monospace; padding: 0 2px; }}
+ .lens .plus {{ background: #e2f2e4; }}
+ .lens .minus {{ background: #f6e1e1; }}
+ .lens sub {{ color: #777; font-size: 9px; }}
  .stats td {{ padding: 0 1em 0 0; color: #444; font-size: 13px; }}
  h2 {{ margin: .2em 0 .5em; font-size: 16px; }}
 </style></head><body>

@@ -111,3 +111,43 @@ def test_analysis_script_end_to_end(tmp_path):
     total = summary["cluster_A_only"] + summary["cluster_shared"] + summary["cluster_B_only"]
     assert total == 64
     assert (tmp_path / "o" / "relative_norm_hist.json").exists()
+
+
+def test_logit_lens_tables(dash_setup):
+    """The fork's per-latent logit tables (nb:cells 33-42): top promoted /
+    suppressed output tokens per source, verified against a direct numpy
+    computation of direction·(1+w_final)·embed^T."""
+    lm_cfg, params, cfg, cc_params, tokens = dash_setup
+    vis_cfg = FeatureVisConfig(hook_point=HP, features=(3, 7), logit_lens_k=5)
+    data = FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_cfg)
+    for fd in data.features:
+        assert len(fd.logit_lens) == 2               # one table per source
+        for tab in fd.logit_lens:
+            m = tab["source"]                        # n_hooks == 1
+            dirs = np.asarray(cc_params["W_dec"], np.float32)[fd.feature, m]
+            w = np.asarray(params[m]["final_norm"], np.float32)
+            emb = np.asarray(params[m]["embed"], np.float32)
+            logits = (dirs * (1.0 + w)) @ emb.T
+            want_top = set(np.argsort(-logits)[:5].tolist())
+            got_top = {t for t, _ in tab["promoted"]}
+            assert got_top == want_top
+            want_bot = set(np.argsort(logits)[:5].tolist())
+            got_bot = {t for t, _ in tab["suppressed"]}
+            assert got_bot == want_bot
+            # promoted values descend, suppressed ascend
+            pv = [v for _, v in tab["promoted"]]
+            sv = [v for _, v in tab["suppressed"]]
+            assert pv == sorted(pv, reverse=True) and sv == sorted(sv)
+
+
+def test_logit_lens_in_html(dash_setup, tmp_path):
+    lm_cfg, params, cfg, cc_params, tokens = dash_setup
+    vis_cfg = FeatureVisConfig(hook_point=HP, features=(0,), logit_lens_k=3)
+    data = FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_cfg)
+    doc = data.save_feature_centric_vis(tmp_path / "v.html").read_text()
+    assert "promoted:" in doc and "suppressed:" in doc
+    # off switch
+    vis_cfg2 = FeatureVisConfig(hook_point=HP, features=(0,), include_logit_lens=False)
+    d2 = FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_cfg2)
+    assert d2.features[0].logit_lens == []
+    assert "promoted:" not in d2.save_feature_centric_vis(tmp_path / "v2.html").read_text()
