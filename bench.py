@@ -1,49 +1,81 @@
-"""Benchmark: crosscoder training-step throughput on one TPU chip.
+"""Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Workload = BASELINE.json's headline config: Gemma-2-2B-shaped activations
-(d_in 2304, n_models 2), batch 4096 rows/step (reference train.py:15),
-dict_size 2^15, bf16 compute — the full train step (fwd, losses, bwd,
-global-norm clip, Adam, schedules) as one donated jitted function.
+Four sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
+exactly ONE JSON line on stdout):
 
-Metric: activation rows consumed per second per chip.
+- **step**: the bare train step on device-resident batches (round-1's
+  headline; BASELINE.json config 1 — dict 2^15, batch 4096, bf16).
+- **matrix**: the sparse tier at the training-step level — activation
+  {relu, topk dense, topk pallas, topk+sparse_decode} × dict
+  {2^15, 2^16, 2^17} (BASELINE.json config 2 is TopK k=32 @ 2^15).
+- **e2e**: the pipeline the reference actually runs (reference
+  buffer.py:66-122 + trainer.py:41-49): harvest→buffer→train, Gemma-2-2B
+  shapes, interleaved incremental refill. Harvest uses REAL-SHAPE random
+  weights truncated to the scanned depth (layers 0-13; the stop-at-layer
+  harvest never executes layers above the hook, so FLOPs are identical to
+  the full model — weights are random because this environment is
+  air-gapped, which changes no matmul shapes). Reports steady-state
+  acts/sec and the refresh-bubble profile (max vs median step).
+- **dash**: dashboard generation at the reference's recorded workload
+  (128 seqs × 3 features, minibatch 4 — BASELINE.md: ≈19 s on A100).
 
-``vs_baseline``: the reference publishes no throughput numbers
-(BASELINE.md), so the denominator is an analytic single-A100 estimate for
-the same torch workload, documented here so it stays fixed across rounds:
-train step ≈ 3× forward FLOPs; forward ≈ 4·B·H·n·d FLOP ⇒ 1.81 GFLOP/row at
-dict 2^15; A100 bf16 peak 312 TFLOP/s at a generous 45% utilization for
-eager torch einsums ⇒ ~77k rows/s. vs_baseline = measured / 77_000.
-(North star: ≥8× via 8-chip DP at per-chip parity — BASELINE.json.)
+Headline metric = e2e acts/sec/chip. ``vs_baseline`` divides by an
+analytic single-A100 torch estimate, documented here so it stays fixed:
+train step ≈ 3× forward FLOPs ⇒ 1.81 GFLOP/row at dict 2^15 ⇒ 77k rows/s
+at 45% of A100 bf16 peak (312 TFLOP/s); harvest = 2 models × 2·P FLOP/row
+over the layers below the hook (P = params in layers 0-13 of Gemma-2-2B
+≈ 1.09 G ⇒ 4.36 GFLOP/row — a resid_pre hook at block 14 executes blocks
+0-13) at the same 45% ⇒ 32.2k rows/s; serial e2e = 1/(1/77k + 1/32.2k)
+≈ 22.7k rows/s. (North star: ≥8× via 8-chip DP at
+per-chip parity — BASELINE.json.)
 
-Prints exactly ONE JSON line.
-
-Env knobs (debug/CI only; defaults are the headline workload): BENCH_DICT,
-BENCH_BATCH, BENCH_STEPS, BENCH_CPU=1 (force the CPU backend).
+Env knobs (debug/CI only): BENCH_SECTIONS, BENCH_DICT, BENCH_BATCH,
+BENCH_STEPS, BENCH_CPU=1, BENCH_MASTER_DTYPE.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
-BASELINE_A100_ACTS_PER_SEC = 77_000.0
+A100_PEAK = 312e12
+A100_UTIL = 0.45
+BASELINE_A100_STEP = 77_000.0
 
 
-def main() -> None:
-    if os.environ.get("BENCH_CPU") == "1":
-        jax.config.update("jax_platforms", "cpu")
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _sync(x) -> float:
+    # sync by FETCHING a scalar, not block_until_ready — under a
+    # remote-tunnel TPU client block_until_ready can return before the
+    # device has executed, which fakes ~1000x speedups
+    return float(jax.device_get(x))
+
+
+def _harvest_flops_per_row(lm_cfg, n_layers_scanned: int, n_models: int) -> float:
+    """2·params FLOP per token per scanned layer, per model."""
+    d, hd = lm_cfg.d_model, lm_cfg.head_dim
+    per_layer = (
+        d * lm_cfg.n_heads * hd            # W_q
+        + 2 * d * lm_cfg.n_kv_heads * hd   # W_k, W_v
+        + lm_cfg.n_heads * hd * d          # W_o
+        + 3 * d * lm_cfg.d_ff              # gate/up/down
+    )
+    return 2.0 * per_layer * n_layers_scanned * n_models
+
+
+def _make_cfg(**overrides):
     from crosscoder_tpu.config import CrossCoderConfig
-    from crosscoder_tpu.parallel import mesh as mesh_lib
-    from crosscoder_tpu.train import schedules
-    from crosscoder_tpu.train.state import init_train_state, make_optimizer
-    from crosscoder_tpu.train.trainer import make_train_step
 
-    cfg = CrossCoderConfig(
-        d_in=2304,
+    base = dict(
+        d_in=int(os.environ.get("BENCH_DIN", 2304)),
         dict_size=int(os.environ.get("BENCH_DICT", 2**15)),
         n_models=2,
         batch_size=int(os.environ.get("BENCH_BATCH", 4096)),
@@ -55,9 +87,20 @@ def main() -> None:
         master_dtype=os.environ.get("BENCH_MASTER_DTYPE", "bf16"),
         log_backend="null",
     )
+    base.update(overrides)
+    return CrossCoderConfig(**base)
+
+
+def bench_step(cfg, n_steps: int, warmup: int = 3) -> dict:
+    """Time the donated jitted train step on device-resident batches."""
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train.trainer import make_train_step
+    from jax.sharding import NamedSharding, PartitionSpec
+
     n_dev = len(jax.devices())
     mesh = mesh_lib.make_mesh(data_axis_size=n_dev, model_axis_size=1)
-
     tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
     state = init_train_state(jax.random.key(cfg.seed), cfg, tx)
     shardings = mesh_lib.state_shardings(mesh, state)
@@ -81,48 +124,271 @@ def main() -> None:
         for i in range(4)
     ]
     # production serve path: raw bf16 rows + on-device per-source norm scale
-    # (length tracks cfg.n_sources so future configs can't shape-mismatch;
-    # 0.26 ≈ the Gemma-2-2B calibration factors, BASELINE.md)
-    from jax.sharding import NamedSharding, PartitionSpec
-
+    # (length tracks cfg.n_sources; 0.26 ≈ the Gemma-2-2B calibration
+    # factors, BASELINE.md)
     scale = jax.device_put(
         jnp.full((cfg.n_sources,), 0.26, jnp.float32),
         NamedSharding(mesh, PartitionSpec()),
     )
 
-    # warmup / compile. NB: sync by FETCHING a scalar, not block_until_ready —
-    # under a remote-tunnel TPU client block_until_ready can return before
-    # the device has executed, which fakes ~1000x speedups; a device_get is
-    # an honest round-trip on every backend.
-    for i in range(3):
+    for i in range(warmup):
         state, metrics = step_fn(state, batches[i % 4], scale)
-    float(jax.device_get(metrics["loss"]))
+    _sync(metrics["loss"])
 
-    n_steps = int(os.environ.get("BENCH_STEPS", 50))
     t0 = time.perf_counter()
     for i in range(n_steps):
         state, metrics = step_fn(state, batches[i % 4], scale)
-    float(jax.device_get(metrics["loss"]))   # one ~70ms RTT amortized over n_steps
+    loss = _sync(metrics["loss"])   # one ~70ms RTT amortized over n_steps
+    dt = time.perf_counter() - t0
+    del state, batches
+    return {
+        "step_ms": round(1000 * dt / n_steps, 2),
+        "acts_per_sec_chip": round(cfg.batch_size * n_steps / dt / n_dev, 1),
+        "loss_finite": bool(jnp.isfinite(loss)),
+        "n_devices": n_dev,
+    }
+
+
+def section_step() -> dict:
+    cfg = _make_cfg()
+    out = bench_step(cfg, int(os.environ.get("BENCH_STEPS", 50)))
+    out["workload"] = (
+        f"d_in {cfg.d_in}, dict {cfg.dict_size}, batch {cfg.batch_size}, "
+        f"relu, bf16 compute, {cfg.master_dtype} masters"
+    )
+    out["vs_a100_step"] = round(out["acts_per_sec_chip"] / BASELINE_A100_STEP, 3)
+    log(f"[step] {out}")
+    return out
+
+
+def section_matrix() -> list[dict]:
+    """The sparse tier, at the training-step level (VERDICT round-1: the
+    in-code perf claims were unverifiable; BASELINE config 2 had no
+    measured number)."""
+    from crosscoder_tpu.ops import activations as act_ops
+
+    on_tpu = jax.default_backend() == "tpu"
+    variants = [
+        ("relu", dict(activation="relu"), "auto"),
+        ("topk_dense", dict(activation="topk", topk_k=32, l1_coeff=0.0), "dense"),
+        ("topk_pallas", dict(activation="topk", topk_k=32, l1_coeff=0.0), "pallas"),
+        ("topk_sparse_decode",
+         dict(activation="topk", topk_k=32, l1_coeff=0.0, sparse_decode=True),
+         "auto"),
+    ]
+    steps = int(os.environ.get("BENCH_MATRIX_STEPS", 12))
+    dicts = tuple(
+        int(x) for x in os.environ.get(
+            "BENCH_MATRIX_DICTS", f"{2**15},{2**16},{2**17}"
+        ).split(",")
+    )
+    out = []
+    for dict_size in dicts:
+        for label, overrides, impl in variants:
+            if impl == "pallas" and not on_tpu:
+                continue               # interpret mode would not be a benchmark
+            act_ops.set_topk_impl(impl)
+            try:
+                r = bench_step(_make_cfg(dict_size=dict_size, **overrides),
+                               steps, warmup=2)
+                entry = {"variant": label, "dict_size": dict_size, **r}
+            except Exception as e:     # one OOM must not kill the bench
+                entry = {"variant": label, "dict_size": dict_size,
+                         "error": f"{type(e).__name__}: {str(e)[:200]}"}
+            finally:
+                act_ops.set_topk_impl("auto")
+            log(f"[matrix] {entry}")
+            out.append(entry)
+    return out
+
+
+def section_e2e() -> dict:
+    """harvest→buffer→train on one chip — the number the reference pipeline
+    actually bounds (harvest ≈ 2.5× the train step's FLOPs per row)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crosscoder_tpu.data.buffer import PairedActivationBuffer
+    from crosscoder_tpu.models import lm
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train.trainer import Trainer
+
+    tiny = os.environ.get("BENCH_TINY") == "1"    # CI/debug only
+    if tiny:
+        hook_layer, full = 2, lm.LMConfig.tiny()
+        lm_cfg = full
+        cfg = _make_cfg(
+            d_in=lm_cfg.d_model, dict_size=256, batch_size=256, buffer_mult=16,
+            model_batch_size=4, norm_calib_batches=2, seq_len=17,
+            hook_point="blocks.2.hook_resid_pre",
+            num_tokens=10**12, save_every=10**9, prefetch=True,
+        )
+    else:
+        hook_layer = 14
+        full = lm.LMConfig.gemma2_2b()
+        # a resid_pre hook at block L executes blocks 0..L-1 and captures at
+        # the virtual layer L (lm._forward_impl n_scan), so only L layers of
+        # params are ever touched; dropping the rest changes no executed op,
+        # saves ~7.5 GB HBM
+        lm_cfg = full.replace(n_layers=hook_layer)
+        cfg = _make_cfg(
+            batch_size=4096, buffer_mult=32, model_batch_size=4,
+            norm_calib_batches=8, seq_len=1024,
+            hook_point=f"blocks.{hook_layer}.hook_resid_pre",
+            num_tokens=10**12, save_every=10**9, prefetch=True,
+        )
+    n_dev = len(jax.devices())
+    mesh = mesh_lib.make_mesh(data_axis_size=n_dev, model_axis_size=1)
+
+    shape_tag = "tiny" if tiny else "gemma-2-2b"
+    log(f"[e2e] initializing 2× {shape_tag}-shaped params ...")
+    params = [lm.init_params(jax.random.key(i), lm_cfg) for i in (0, 1)]
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, lm_cfg.vocab_size, size=(2048, cfg.seq_len),
+                          dtype=np.int32)
+
+    t0 = time.perf_counter()
+    buffer = PairedActivationBuffer(
+        cfg, lm_cfg, params, tokens,
+        batch_sharding=NamedSharding(mesh, P("data", None)),
+    )
+    fill_s = time.perf_counter() - t0
+    log(f"[e2e] calibration + first fill ({buffer.buffer_size} rows): {fill_s:.1f}s")
+
+    trainer = Trainer(cfg, buffer, mesh=mesh)
+    # warmup: compile both step variants + the serve path
+    m = trainer.step()
+    _sync(m["loss"])
+    m = trainer.step(full_metrics=False)
+    _sync(m["loss"])
+
+    # phase A — steady-state throughput: enqueue, sync once at the end
+    n_steps = int(os.environ.get("BENCH_E2E_STEPS", 40))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        m = trainer.step(full_metrics=False)
+    loss = _sync(m["loss"])
     dt = time.perf_counter() - t0
 
-    acts_per_sec = cfg.batch_size * n_steps / dt
-    per_chip = acts_per_sec / n_dev
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"crosscoder train acts/sec/chip (d_in {cfg.d_in}, dict {cfg.dict_size}, "
-                    f"bf16 compute, {cfg.master_dtype} masters)"
-                ),
-                "value": round(per_chip, 1),
-                "unit": "activations/s/chip",
-                "vs_baseline": round(per_chip / BASELINE_A100_ACTS_PER_SEC, 3),
-                "n_devices": n_dev,
-                "step_ms": round(1000 * dt / n_steps, 2),
-                "loss_finite": bool(jnp.isfinite(metrics["loss"]).item()),
-            }
-        )
+    # phase B — per-step profile (per-step sync adds one RTT to every step
+    # equally; the refresh bubble shows up as max − median)
+    times = []
+    for _ in range(16):
+        t1 = time.perf_counter()
+        m = trainer.step(full_metrics=False)
+        _sync(m["loss"])
+        times.append(1000 * (time.perf_counter() - t1))
+    trainer.close()
+    times_sorted = sorted(times)
+    median_ms = times_sorted[len(times) // 2]
+
+    harvest_flops = _harvest_flops_per_row(full, hook_layer, cfg.n_models)
+    a100_harvest = A100_PEAK * A100_UTIL / harvest_flops
+    a100_e2e = 1.0 / (1.0 / BASELINE_A100_STEP + 1.0 / a100_harvest)
+    acts = cfg.batch_size * n_steps / dt / n_dev
+    out = {
+        "acts_per_sec_chip": round(acts, 1),
+        "vs_a100_e2e": round(acts / a100_e2e, 3),
+        "a100_e2e_estimate": round(a100_e2e, 1),
+        "harvest_gflop_per_row": round(harvest_flops / 1e9, 2),
+        "first_fill_s": round(fill_s, 1),
+        "step_ms_median": round(median_ms, 2),
+        "step_ms_max": round(max(times), 2),
+        "refresh_bubble_ms": round(max(times) - median_ms, 2),
+        "n_steps_measured": n_steps,
+        "loss_finite": bool(jnp.isfinite(loss)),
+        "workload": (
+            f"{shape_tag} pair → blocks.{hook_layer} harvest → buffer(mult "
+            f"{cfg.buffer_mult}) → train dict {cfg.dict_size}, batch {cfg.batch_size}"
+        ),
+    }
+    log(f"[e2e] {out}")
+    return out
+
+
+def section_dash() -> dict:
+    """Dashboard generation at the reference's recorded sae_vis workload:
+    128 seqs × 3 features, minibatch 4 (BASELINE.md: fwd 14.08 s + feature
+    acts 3.71 s ≈ 19 s total on A100)."""
+    import numpy as np
+
+    from crosscoder_tpu.analysis.dashboards import FeatureVisConfig, FeatureVisData
+    from crosscoder_tpu.models import crosscoder as cc
+    from crosscoder_tpu.models import lm
+
+    tiny = os.environ.get("BENCH_TINY") == "1"    # CI/debug only
+    if tiny:
+        hook_layer, lm_cfg = 2, lm.LMConfig.tiny()
+        cfg = _make_cfg(d_in=lm_cfg.d_model, dict_size=256, enc_dtype="fp32")
+        n_seqs, seq_len = 16, 24
+    else:
+        hook_layer = 14
+        lm_cfg = lm.LMConfig.gemma2_2b().replace(n_layers=hook_layer)
+        cfg = _make_cfg(dict_size=2**14, enc_dtype="bf16")   # published shape
+        n_seqs, seq_len = 128, 1024
+    params = [lm.init_params(jax.random.key(i), lm_cfg) for i in (0, 1)]
+    cc_params = cc.init_params(jax.random.key(2), cfg)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, lm_cfg.vocab_size, size=(n_seqs, seq_len), dtype=np.int32)
+    vis_cfg = FeatureVisConfig(
+        hook_point=f"blocks.{hook_layer}.hook_resid_pre",
+        features=(7, 11, 13), minibatch_size_tokens=4,
     )
+
+    def run() -> float:
+        t0 = time.perf_counter()
+        FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_cfg)
+        return time.perf_counter() - t0
+
+    cold = run()
+    warm = run()
+    out = {
+        "cold_s": round(cold, 2),
+        "steady_s": round(warm, 2),
+        "reference_a100_s": 19.0,
+        "vs_reference": round(19.0 / warm, 2),
+        "workload": f"{n_seqs} seqs × 3 features, minibatch 4, "
+                    f"{'tiny' if tiny else 'gemma-2-2b'} shapes",
+    }
+    log(f"[dash] {out}")
+    return out
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    sections = os.environ.get("BENCH_SECTIONS", "step,matrix,e2e,dash").split(",")
+    results: dict = {}
+    for name, fn in (("step", section_step), ("matrix", section_matrix),
+                     ("e2e", section_e2e), ("dash", section_dash)):
+        if name not in sections:
+            continue
+        try:
+            results[name] = fn()
+        except Exception as e:
+            results[name] = {"error": f"{type(e).__name__}: {str(e)[:300]}"}
+            log(f"[{name}] FAILED: {results[name]['error']}")
+
+    e2e = results.get("e2e", {})
+    step = results.get("step", {})
+    if "acts_per_sec_chip" in e2e:
+        headline = {
+            "metric": "end-to-end harvest→buffer→train acts/sec/chip "
+                      f"({e2e['workload']})",
+            "value": e2e["acts_per_sec_chip"],
+            "unit": "activations/s/chip",
+            "vs_baseline": e2e["vs_a100_e2e"],
+        }
+    else:   # e2e skipped/failed: fall back to round-1's step-only headline
+        headline = {
+            "metric": "crosscoder train acts/sec/chip "
+                      f"({step.get('workload', 'step section failed')})",
+            "value": step.get("acts_per_sec_chip"),
+            "unit": "activations/s/chip",
+            "vs_baseline": step.get("vs_a100_step"),
+        }
+    headline.update(results)
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

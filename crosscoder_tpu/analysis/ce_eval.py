@@ -55,31 +55,14 @@ class Reconstructor(NamedTuple):
     apply: Callable[[object, jnp.ndarray], jnp.ndarray]
 
 
-# apply-function cache keyed by the cfg's JSON identity: ``apply`` is a
-# STATIC jit argument of _chunk_ces, so repeated evals with the same config
-# must reuse one function object or every eval pays a full recompile (and
-# the jit cache would retain every stale executable)
-_APPLY_CACHE: dict[str, Callable] = {}
-
-
 def crosscoder_reconstruct_fn(
     params: cc.Params, cfg: CrossCoderConfig
 ) -> Reconstructor:
     """rows ``[N, n_sources, d_in]`` → reconstructed rows, via the (folded)
-    crosscoder (nb:cell 29: ``cc.decode(cc.encode(x))``)."""
-    import json
-
-    key = json.dumps(cfg.to_dict(), sort_keys=True, default=str)
-    apply = _APPLY_CACHE.get(key)
-    if apply is None:
-        if len(_APPLY_CACHE) > 16:
-            _APPLY_CACHE.clear()
-
-        def apply(p: cc.Params, x: jnp.ndarray) -> jnp.ndarray:
-            return cc.forward(p, x, cfg)
-
-        _APPLY_CACHE[key] = apply
-    return Reconstructor(params=params, apply=apply)
+    crosscoder (nb:cell 29: ``cc.decode(cc.encode(x))``). The apply function
+    comes from :func:`crosscoder_tpu.models.crosscoder.cached_apply`, so
+    repeated evals with the same config reuse one compiled program."""
+    return Reconstructor(params=params, apply=cc.cached_apply(cfg, "forward"))
 
 
 def _as_reconstructor(reconstruct) -> Reconstructor:

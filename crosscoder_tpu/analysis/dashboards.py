@@ -74,6 +74,23 @@ class FeatureData:
     # top promoted/suppressed output-token tables (nb:cells 33-42)
 
 
+@functools.partial(jax.jit, static_argnames=("lm_cfg", "hook_point", "encode_apply"))
+def _latent_acts_impl(
+    mparams: tuple, ccp, feats: jax.Array, tok: jax.Array,
+    lm_cfg: lm.LMConfig, hook_point: str, encode_apply,
+) -> jax.Array:
+    """Selected latents' activations for one token minibatch
+    ``[B, S-1, n_feats]``. Module-level jit with params as ARGUMENTS:
+    a per-create closure would (a) bake 2×Gemma-2-2B into the program as
+    constants (10.6 GB, explodes lowering) and (b) recompile on every
+    ``FeatureVisData.create`` call — the steady-state dashboard cost must
+    be harvest+encode, not trace+compile."""
+    x = lm.run_with_cache_multi(mparams, tok, lm_cfg, (hook_point,))
+    x = x[:, 1:]                                    # drop BOS
+    f = encode_apply(ccp, x.astype(jnp.float32))
+    return f[..., feats]
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def _logit_lens_topk(w_sel: jax.Array, embed: jax.Array, w_final: jax.Array, k: int):
     """Linear logit lens of decoder directions through ONE model's head:
@@ -142,18 +159,13 @@ class FeatureVisData:
         rel = np.asarray(dec_analysis.relative_norms(cc_params))[list(vis_cfg.features)]
         cos = np.asarray(dec_analysis.cosine_sims(cc_params))[list(vis_cfg.features)]
 
-        # params must be jit ARGUMENTS, not closed-over values — a closure
-        # bakes them into the program as constants (10.6 GB of captured
-        # constants for 2x Gemma-2-2B), which explodes lowering/compile
-        @jax.jit
-        def _latent_acts(mparams, ccp, tok: jax.Array) -> jax.Array:
-            x = lm.run_with_cache_multi(mparams, tok, lm_cfg, (vis_cfg.hook_point,))
-            x = x[:, 1:]                                    # drop BOS
-            f = cc.encode(ccp, x.astype(jnp.float32), cc_cfg)
-            return f[..., feats]                            # [B, S-1, n_feats]
+        encode_apply = cc.cached_apply(cc_cfg, "encode")
 
         def latent_acts(tok: jax.Array) -> jax.Array:
-            return _latent_acts(tuple(model_params), cc_params, tok)
+            return _latent_acts_impl(
+                tuple(model_params), cc_params, feats, tok, lm_cfg,
+                vis_cfg.hook_point, encode_apply,
+            )
 
         tokens = np.asarray(tokens)
         mb = vis_cfg.minibatch_size_tokens

@@ -1,0 +1,135 @@
+"""Air-gapped demonstration harness: a deterministic synthetic-language LM
+pair plus a crosscoder trained on their paired activations.
+
+The reference's acceptance artifacts (3-cluster histogram, shared-latent
+cosines, CE-recovered ≈ 0.92, dashboards — nb:cells 13-42) are defined on
+the published Gemma-2-2B checkpoint, which needs network access. This
+module builds the closest executable-anywhere analogue: two tiny LMs
+trained (from different seeds) on the same fully-predictable language —
+so their residual streams carry real, learnable, partially-shared
+structure — and a crosscoder trained on the genuine
+harvest→buffer→train path. ``scripts/eval_ce.py --demo`` and
+``scripts/replicate.py --demo`` run the full analysis stack on top.
+
+Everything is deterministic (fixed seeds, fixed corpus)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+# deterministic synthetic language: x_{t+1} = (5·x_t + 17) mod V with a
+# random start token — fully predictable from the current token, so a tiny
+# LM learns it and mid-stack ablation has a large, real CE cost
+DEMO_VOCAB = 257
+DEMO_SEQ_LEN = 33
+DEMO_HOOK = "blocks.2.hook_resid_pre"
+
+
+def synthetic_language_tokens(
+    n_seqs: int = 512, seq_len: int = DEMO_SEQ_LEN, vocab: int = DEMO_VOCAB,
+    seed: int = 11, frac_alt: float = 0.0,
+) -> np.ndarray:
+    """``frac_alt`` of the sequences follow a SECOND affine rule
+    (x→7x+3 instead of x→5x+17), deterministically interleaved — the
+    "instruction-tuning distribution shift" of the demo."""
+    rng = np.random.default_rng(seed)
+    tokens = np.zeros((n_seqs, seq_len), dtype=np.int64)
+    tokens[:, 0] = rng.integers(0, vocab, size=n_seqs)
+    alt = (np.arange(n_seqs) % 10) < round(frac_alt * 10)
+    for t in range(1, seq_len):
+        x = tokens[:, t - 1]
+        tokens[:, t] = np.where(alt, (7 * x + 3) % vocab, (5 * x + 17) % vocab)
+    return tokens
+
+
+def train_tiny_lm(key, lm_cfg, tokens: np.ndarray, steps: int, lr: float = 3e-3,
+                  init_params=None):
+    """Adam-train a tiny LM on the synthetic language until it beats the
+    uniform baseline by a wide margin (so zero-ablation has a real cost and
+    the CE-recovered denominator is meaningful). ``init_params`` continues
+    training from existing weights (the fine-tune path). Returns
+    (params, final CE)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from crosscoder_tpu.models import lm
+
+    if steps < 1:
+        raise ValueError("steps must be >= 1")
+    params = lm.init_params(key, lm_cfg) if init_params is None else init_params
+    tx = optax.adam(lr)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, tok):
+        def loss(p):
+            logits, _ = lm.forward(p, tok, lm_cfg)
+            return lm.loss_fn(logits, tok)
+
+        l, g = jax.value_and_grad(loss)(params)
+        upd, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, upd), opt, l
+
+    n = tokens.shape[0]
+    for i in range(steps):
+        batch = jnp.asarray(tokens[(i * 16) % n: (i * 16) % n + 16])
+        params, opt, l = step(params, opt, batch)
+    return params, float(l)
+
+
+def build_demo_pair(lm_steps: int = 400):
+    """(lm_cfg, [params_A, params_B], tokens, train CEs).
+
+    Model B is a FINE-TUNE of model A on a shifted language (a second
+    affine rule mixed in) — mirroring the reference's base-vs-IT pair: the
+    models share a residual basis (so shared crosscoder latents get high
+    decoder cosines, nb:cells 21-22) while B carries rule-2-specific
+    structure A lacks. Two independently-initialized models would share no
+    basis at all, which is model *comparison*, not model *diffing*.
+
+    The returned tokens are the 70/30 mixed corpus both harvest and eval
+    use (covers both models' behaviors)."""
+    import jax
+
+    from crosscoder_tpu.models import lm
+
+    base_tokens = synthetic_language_tokens(frac_alt=0.0)
+    tune_tokens = synthetic_language_tokens(seed=12, frac_alt=1.0)
+    mixed_tokens = synthetic_language_tokens(seed=13, frac_alt=0.3)
+    lm_cfg = lm.LMConfig.tiny(vocab_size=DEMO_VOCAB)
+    pa, la = train_tiny_lm(jax.random.key(0), lm_cfg, base_tokens, lm_steps)
+    # gentle fine-tune (lower lr, fewer steps): B must LEARN rule 2 while
+    # keeping A's residual basis — drift too far and the shared latents'
+    # decoder cosines collapse, the very property being replicated
+    pb, lb = train_tiny_lm(jax.random.key(1), lm_cfg, tune_tokens,
+                           max(1, lm_steps // 3), lr=1e-3, init_params=pa)
+    return lm_cfg, [pa, pb], mixed_tokens, {
+        "A": la, "B": lb, "uniform": float(np.log(DEMO_VOCAB))
+    }
+
+
+def train_demo_crosscoder(lm_cfg, model_params, tokens: np.ndarray, cc_steps: int = 1500):
+    """Train a crosscoder on the demo pair via the REAL pipeline
+    (PairedActivationBuffer harvest → mesh trainer). Returns
+    (cc_params, cfg, normalisation_factor, final metrics)."""
+    import jax
+
+    from crosscoder_tpu.config import CrossCoderConfig
+    from crosscoder_tpu.data.buffer import PairedActivationBuffer
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train.trainer import Trainer
+
+    cfg = CrossCoderConfig(
+        d_in=lm_cfg.d_model, dict_size=1024, batch_size=256, buffer_mult=64,
+        seq_len=tokens.shape[1], model_batch_size=16, norm_calib_batches=4,
+        hook_point=DEMO_HOOK, num_tokens=256 * cc_steps,
+        enc_dtype="fp32", l1_coeff=0.3, lr=1e-3, log_backend="null",
+        checkpoint_dir="", save_every=10**9,
+    )
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    buffer = PairedActivationBuffer(cfg, lm_cfg, model_params, tokens)
+    trainer = Trainer(cfg, buffer, mesh=mesh)
+    final = trainer.train()
+    params = jax.device_get(trainer.state.params)
+    return params, cfg, np.asarray(buffer.normalisation_factor), final

@@ -128,6 +128,40 @@ def forward(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> jax.Array:
     return decode(params, encode(params, x, cfg))
 
 
+# apply-function cache keyed by the cfg's JSON identity. Consumers (CE eval,
+# dashboards) close cfg into a function and pass that function as a STATIC
+# jit argument with params/activations as array arguments; without this
+# cache each call site would mint a fresh function object → a full retrace
+# and recompile per eval/dashboard run, and the jit cache would retain
+# every stale executable.
+_APPLY_CACHE: dict[tuple[str, str], Any] = {}
+
+
+def cached_apply(cfg: CrossCoderConfig, kind: str = "forward"):
+    """A stable-identity ``apply(params, x)`` for this config.
+
+    ``kind``: ``"forward"`` (encode→decode, the CE eval's reconstruction)
+    or ``"encode"`` (latent activations, the dashboards' path).
+    """
+    import json
+
+    if kind not in ("forward", "encode"):
+        raise ValueError(f"kind must be forward|encode, got {kind!r}")
+    key = (json.dumps(cfg.to_dict(), sort_keys=True, default=str), kind)
+    fn = _APPLY_CACHE.get(key)
+    if fn is None:
+        if len(_APPLY_CACHE) > 32:
+            _APPLY_CACHE.clear()
+        if kind == "forward":
+            def fn(p: Params, x: jax.Array) -> jax.Array:
+                return forward(p, x, cfg)
+        else:
+            def fn(p: Params, x: jax.Array) -> jax.Array:
+                return encode(p, x, cfg)
+        _APPLY_CACHE[key] = fn
+    return fn
+
+
 # ---------------------------------------------------------------------------
 # sparse TopK decode (no reference counterpart — the reference's decode is
 # always the dense [B,H]x[H,n,d] matmul, reference crosscoder.py:82-89,

@@ -166,6 +166,25 @@ def apply(h: jax.Array, cfg: "CrossCoderConfig", params: dict | None = None) -> 
     raise ValueError(f"unknown activation {cfg.activation!r}")
 
 
-@functools.lru_cache(maxsize=1)
+# "auto": Pallas kernel on TPU when shapes allow, dense elsewhere.
+# set_topk_impl("dense"/"pallas") forces one path — benchmarking both
+# tiers at the training-step level and debugging kernel mismatches.
+_TOPK_IMPL = "auto"
+
+
+def set_topk_impl(impl: str) -> None:
+    if impl not in ("auto", "pallas", "dense"):
+        raise ValueError(f"impl must be auto|pallas|dense, got {impl!r}")
+    global _TOPK_IMPL
+    _TOPK_IMPL = impl
+
+
 def _default_use_pallas() -> bool:
+    if _TOPK_IMPL != "auto":
+        return _TOPK_IMPL == "pallas"
+    return _backend_is_tpu()
+
+
+@functools.lru_cache(maxsize=1)
+def _backend_is_tpu() -> bool:
     return jax.default_backend() == "tpu"

@@ -110,93 +110,34 @@ def run_real(args) -> dict:
 # air-gapped demo gate
 
 
-def _train_tiny_lm(key, lm_cfg, tokens, steps: int, lr: float = 3e-3):
-    """Adam-train a tiny LM on the synthetic language until it beats the
-    uniform baseline by a wide margin (so zero-ablation has a real cost and
-    the recovered metric's denominator is meaningful)."""
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from crosscoder_tpu.models import lm
-
-    if steps < 1:
-        raise SystemExit("--demo-lm-steps must be >= 1")
-    params = lm.init_params(key, lm_cfg)
-    tx = optax.adam(lr)
-    opt = tx.init(params)
-
-    @jax.jit
-    def step(params, opt, tok):
-        def loss(p):
-            logits, _ = lm.forward(p, tok, lm_cfg)
-            return lm.loss_fn(logits, tok)
-
-        l, g = jax.value_and_grad(loss)(params)
-        upd, opt = tx.update(g, opt, params)
-        return optax.apply_updates(params, upd), opt, l
-
-    n = tokens.shape[0]
-    for i in range(steps):
-        batch = jnp.asarray(tokens[(i * 16) % n: (i * 16) % n + 16])
-        params, opt, l = step(params, opt, batch)
-    return params, float(l)
-
-
 def run_demo(args) -> dict:
     """The full gate, air-gapped: synthetic language → two trained tiny LMs
     → paired-activation harvest → crosscoder training → fold → splice eval,
-    plus the identity/zero oracle checks."""
-    import jax
+    plus the identity/zero oracle checks (machinery shared with
+    scripts/replicate.py via crosscoder_tpu.demo)."""
     import jax.numpy as jnp
 
+    from crosscoder_tpu import demo
     from crosscoder_tpu.analysis.ce_eval import (
         crosscoder_reconstruct_fn,
         get_ce_recovered_metrics,
     )
-    from crosscoder_tpu.config import CrossCoderConfig
-    from crosscoder_tpu.data.buffer import PairedActivationBuffer
     from crosscoder_tpu.models import crosscoder as cc
-    from crosscoder_tpu.models import lm
-    from crosscoder_tpu.parallel import mesh as mesh_lib
-    from crosscoder_tpu.train.trainer import Trainer
 
-    # deterministic synthetic language: x_{t+1} = (5·x_t + 17) mod V with a
-    # random start token — fully predictable from the current token, so a
-    # tiny LM learns it and mid-stack ablation has a large, real CE cost
-    V, S, NSEQ = 257, 33, 512
-    rng = np.random.default_rng(11)
-    x0 = rng.integers(0, V, size=(NSEQ, 1))
-    tokens = np.zeros((NSEQ, S), dtype=np.int64)
-    tokens[:, :1] = x0
-    for t in range(1, S):
-        tokens[:, t] = (5 * tokens[:, t - 1] + 17) % V
-
-    lm_cfg = lm.LMConfig.tiny(vocab_size=V)
     print("[demo] training tiny LM pair on the synthetic language ...")
-    pa, la = _train_tiny_lm(jax.random.key(0), lm_cfg, tokens, args.demo_lm_steps)
-    pb, lb = _train_tiny_lm(jax.random.key(1), lm_cfg, tokens, args.demo_lm_steps)
-    print(f"[demo] LM train CE: A={la:.3f} B={lb:.3f} (uniform={np.log(V):.3f})")
+    lm_cfg, model_params, tokens, lm_ces = demo.build_demo_pair(args.demo_lm_steps)
+    la, lb = lm_ces["A"], lm_ces["B"]
+    print(f"[demo] LM train CE: A={la:.3f} B={lb:.3f} (uniform={lm_ces['uniform']:.3f})")
 
-    hook = "blocks.2.hook_resid_pre"
-    cfg = CrossCoderConfig(
-        d_in=lm_cfg.d_model, dict_size=1024, batch_size=256, buffer_mult=64,
-        seq_len=S, model_batch_size=16, norm_calib_batches=4,
-        hook_point=hook, num_tokens=256 * args.demo_cc_steps,
-        enc_dtype="fp32", l1_coeff=0.3, lr=1e-3, log_backend="null",
-        checkpoint_dir="", save_every=10**9,
+    hook = demo.DEMO_HOOK
+    print(f"[demo] training crosscoder for {args.demo_cc_steps} steps ...")
+    params, cfg, norm_factors, final = demo.train_demo_crosscoder(
+        lm_cfg, model_params, tokens, args.demo_cc_steps
     )
-    mesh = mesh_lib.mesh_from_cfg(cfg)
-    buffer = PairedActivationBuffer(cfg, lm_cfg, [pa, pb], tokens)
-    print(f"[demo] training crosscoder for {cfg.total_steps} steps ...")
-    trainer = Trainer(cfg, buffer, mesh=mesh)
-    final = trainer.train()
     print(f"[demo] crosscoder final: {final}")
 
-    params = jax.device_get(trainer.state.params)
-    folded = cc.fold_scaling_factors(
-        params, jnp.asarray(buffer.normalisation_factor)
-    )
+    pa, pb = model_params
+    folded = cc.fold_scaling_factors(params, jnp.asarray(norm_factors))
     eval_tokens = tokens[: args.n_seqs or 64]
 
     print("[demo] oracle checks ...")
@@ -213,7 +154,7 @@ def run_demo(args) -> dict:
 
     out = {
         "mode": "demo (air-gapped; synthetic-language LM pair, trained crosscoder)",
-        "lm_train_ce": {"A": la, "B": lb, "uniform": float(np.log(V))},
+        "lm_train_ce": lm_ces,
         "crosscoder_final": {k: float(v) for k, v in final.items()},
         **metrics,
         "oracle_identity_recovered": {
@@ -234,8 +175,13 @@ def run_demo(args) -> dict:
         and out["oracle_zero_recovered"]["B"] < 0.5
         and out["ce_recovered_A"] > 0.6
         and out["ce_recovered_B"] > 0.6
-        and out["ce_recovered_A"] <= 1.005
-        and out["ce_recovered_B"] <= 1.005
+        # ceiling is loose: a good crosscoder's reconstruction can slightly
+        # DENOISE (model A never saw the mixed corpus's rule-2 sequences, so
+        # reconstruction through shared latents regularizes its stream and
+        # spliced CE dips a hair below clean) — recovered just must not run
+        # away past 1
+        and out["ce_recovered_A"] <= 1.02
+        and out["ce_recovered_B"] <= 1.02
         # ablation must genuinely hurt, or "recovered" is vacuous (a
         # near-perfect crosscoder can make ce_diff slightly NEGATIVE —
         # reconstruction denoises — so only the denominator is gated)
@@ -244,6 +190,13 @@ def run_demo(args) -> dict:
     )
     out["gate_pass"] = bool(ok)
     return out
+
+
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError("must be >= 1")
+    return v
 
 
 def main(argv=None):
@@ -259,8 +212,8 @@ def main(argv=None):
     ap.add_argument("--n-seqs", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=4)
     ap.add_argument("--norm-factors", type=str, default=None, help="a,b fold factors")
-    ap.add_argument("--demo-lm-steps", type=int, default=400)
-    ap.add_argument("--demo-cc-steps", type=int, default=1500)
+    ap.add_argument("--demo-lm-steps", type=_positive_int, default=400)
+    ap.add_argument("--demo-cc-steps", type=_positive_int, default=1500)
     ap.add_argument("--out", type=str, default=None, help="write metrics JSON here")
     ap.add_argument(
         "--platform", type=str, default=None, choices=("cpu", "tpu"),

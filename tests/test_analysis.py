@@ -162,3 +162,28 @@ def test_eval_ce_script_demo_smoke(tmp_path):
             assert np.isfinite(m[f"{k}_{tag}"])
     assert abs(m["oracle_identity_recovered"]["A"] - 1) < 1e-3
     assert "gate_pass" in m
+
+
+def test_replicate_script_demo_smoke(tmp_path):
+    """scripts/replicate.py --demo with tiny budgets: all four stages run
+    and the report/dashboards artifacts land. Quality gates are asserted by
+    the default-budget run (artifacts/replicate_demo)."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = Path(__file__).parent.parent / "scripts" / "replicate.py"
+    out = tmp_path / "rep"
+    proc = subprocess.run(
+        [sys.executable, str(script), "--demo", "--demo-lm-steps", "30",
+         "--demo-cc-steps", "20", "--n-seqs", "8", "--out", str(out)],
+        capture_output=True, text=True, timeout=300,
+        cwd=Path(__file__).parent.parent,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    report = json.loads((out / "replicate_report.json").read_text())
+    assert report["decoder"]["d_hidden"] == 1024
+    assert "ce_recovered_A" in report["ce"]
+    assert (out / "dashboards.html").exists()
+    assert "checks" in report and "all_pass" in report["checks"]
