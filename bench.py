@@ -185,8 +185,17 @@ def section_matrix() -> list[dict]:
     out = []
     for dict_size in dicts:
         for label, overrides, impl in variants:
-            if impl == "pallas" and not on_tpu:
-                continue               # interpret mode would not be a benchmark
+            if impl == "pallas":
+                from crosscoder_tpu.ops import topk_pallas
+
+                probe = jax.ShapeDtypeStruct((1, dict_size), jnp.bfloat16)
+                if not on_tpu:
+                    continue           # interpret mode is not a benchmark
+                if not topk_pallas.supported(probe, 32):
+                    out.append({"variant": label, "dict_size": dict_size,
+                                "skipped": "kernel unsupported at this width "
+                                           "(VMEM gate; dense path is faster)"})
+                    continue
             act_ops.set_topk_impl(impl)
             try:
                 r = bench_step(_make_cfg(dict_size=dict_size, **overrides),
@@ -208,7 +217,7 @@ def section_e2e() -> dict:
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from crosscoder_tpu.data.buffer import PairedActivationBuffer
+    from crosscoder_tpu.data.buffer import make_buffer
     from crosscoder_tpu.models import lm
     from crosscoder_tpu.parallel import mesh as mesh_lib
     from crosscoder_tpu.train.trainer import Trainer
@@ -247,8 +256,14 @@ def section_e2e() -> dict:
     tokens = rng.integers(0, lm_cfg.vocab_size, size=(2048, cfg.seq_len),
                           dtype=np.int32)
 
+    # store placement: HBM by default on a single chip — zero host<->device
+    # row traffic. BENCH_BUFFER=host measures the host-RAM path instead
+    # (on a remote-TUNNEL client that path is transfer-bound: ~75 MB/step
+    # at ~7 MB/s; on a local PCIe link the cost is negligible).
+    buffer_device = os.environ.get("BENCH_BUFFER", "hbm")
+    cfg = cfg.replace(buffer_device=buffer_device)
     t0 = time.perf_counter()
-    buffer = PairedActivationBuffer(
+    buffer = make_buffer(
         cfg, lm_cfg, params, tokens,
         batch_sharding=NamedSharding(mesh, P("data", None)),
     )
@@ -297,9 +312,11 @@ def section_e2e() -> dict:
         "refresh_bubble_ms": round(max(times) - median_ms, 2),
         "n_steps_measured": n_steps,
         "loss_finite": bool(jnp.isfinite(loss)),
+        "buffer_device": buffer_device,
         "workload": (
-            f"{shape_tag} pair → blocks.{hook_layer} harvest → buffer(mult "
-            f"{cfg.buffer_mult}) → train dict {cfg.dict_size}, batch {cfg.batch_size}"
+            f"{shape_tag} pair → blocks.{hook_layer} harvest → {buffer_device} "
+            f"buffer(mult {cfg.buffer_mult}) → train dict {cfg.dict_size}, "
+            f"batch {cfg.batch_size}"
         ),
     }
     log(f"[e2e] {out}")

@@ -80,6 +80,11 @@ class CrossCoderConfig:
     jumprelu_bandwidth: float = 0.001  # STE bandwidth for the threshold gradient
     data_axis_size: int = -1        # -1: all remaining devices on the data axis
     model_axis_size: int = 1        # tensor-parallel shards of the dict axis
+    buffer_device: str = "host"     # replay store placement: host RAM (big
+                                    # buffers, multi-host, analysis reads)
+                                    # | "hbm" (single-chip: zero host↔device
+                                    # row traffic — the reference's own
+                                    # placement, buffer.py:18-22)
     seq_shards: int = 0             # >0: harvest forwards shard the SEQUENCE
                                     # axis over the mesh data axis (ring
                                     # attention), for contexts too long for
@@ -125,6 +130,10 @@ class CrossCoderConfig:
             raise ValueError(f"data_source must be 'gemma' or 'synthetic', got {self.data_source!r}")
         if self.master_dtype not in ("fp32", "bf16"):
             raise ValueError(f"master_dtype must be fp32 or bf16, got {self.master_dtype!r}")
+        if self.buffer_device not in ("host", "hbm"):
+            raise ValueError(
+                f"buffer_device must be 'host' or 'hbm', got {self.buffer_device!r}"
+            )
         if self.seq_shards < 0:
             raise ValueError("seq_shards must be >= 0")
         if self.seq_shards > 1 and self.seq_len % self.seq_shards != 0:

@@ -116,7 +116,7 @@ def train_demo_crosscoder(lm_cfg, model_params, tokens: np.ndarray, cc_steps: in
     import jax
 
     from crosscoder_tpu.config import CrossCoderConfig
-    from crosscoder_tpu.data.buffer import PairedActivationBuffer
+    from crosscoder_tpu.data.buffer import make_buffer
     from crosscoder_tpu.parallel import mesh as mesh_lib
     from crosscoder_tpu.train.trainer import Trainer
 
@@ -128,7 +128,7 @@ def train_demo_crosscoder(lm_cfg, model_params, tokens: np.ndarray, cc_steps: in
         checkpoint_dir="", save_every=10**9,
     )
     mesh = mesh_lib.mesh_from_cfg(cfg)
-    buffer = PairedActivationBuffer(cfg, lm_cfg, model_params, tokens)
+    buffer = make_buffer(cfg, lm_cfg, model_params, tokens)
     trainer = Trainer(cfg, buffer, mesh=mesh)
     final = trainer.train()
     params = jax.device_get(trainer.state.params)

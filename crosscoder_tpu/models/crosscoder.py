@@ -167,14 +167,15 @@ def cached_apply(cfg: CrossCoderConfig, kind: str = "forward"):
 # always the dense [B,H]x[H,n,d] matmul, reference crosscoder.py:82-89,
 # which at TopK(k=32) multiplies ~0.1% nonzeros).
 #
-# Measured guidance (TPU v5e, dict 2^15, k 32, batch 4096): the DENSE path
-# wins — 53.6 vs 93.4 ms/step — because at B·k/H ≈ 4 hits per latent every
-# W_dec row is read anyway, the dense matmul is a compute-bound MXU op only
-# ~4x off the bandwidth floor, and XLA's row gather runs ~12x below HBM
-# bandwidth. This path is the correctness-verified scaffold for the regime
-# where sparsity actually pays (dict 2^17+, where the dense matmul's FLOPs
-# dominate) — there a Pallas scalar-prefetch gather kernel replaces
-# jnp.take; until then cfg.sparse_decode defaults to False.
+# Measured guidance (TPU v5e, k 32, batch 4096, full train step —
+# artifacts/BENCH_r02_local.json matrix): at dict 2^15 the DENSE decode
+# wins (77.1 vs 94.9 ms/step) because at B·k/H ≈ 4 hits per latent every
+# W_dec row is read anyway, the dense matmul is a compute-bound MXU op,
+# and XLA's row gather runs well below HBM bandwidth. The crossover lands
+# at dict 2^17 where the dense matmul's FLOPs dominate and this path wins
+# (252.0 vs 281.0 ms/step); at 2^16 they are within noise (159.4 vs
+# 156.3, dense slightly ahead). Default stays cfg.sparse_decode=False;
+# flip it at 2^17+.
 
 
 @jax.custom_vjp

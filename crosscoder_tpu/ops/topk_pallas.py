@@ -43,13 +43,33 @@ from jax.experimental.pallas import tpu as pltpu
 # ~16 MB/core budget. Row counts are multiples of 32 so the block's sublane
 # dimension satisfies every dtype's min-tile requirement (fp32 8, bf16 16,
 # int8/fp8 32).
+#
+# Width gate (measured on v5e, k=32): the kernel needs a >=32-row block to
+# keep the VPU busy through the 31 bisection sweeps. At bf16 width 2^15 a
+# 32-row block (~12.6 MB working set: in + out + two f32 temporaries per
+# element) fits VMEM and the kernel beats dense lax.top_k 1.4x at the step
+# level. At 2^16 a 32-row block fails to compile (VMEM), and the
+# 16-row fallback block compiles but runs ~70x slower per element than the
+# 2^15 block — so any width whose 32-row working set exceeds the budget is
+# UNSUPPORTED and dispatch falls back to the dense path, which is also the
+# faster choice there.
 _TARGET_BLOCK_BYTES = 2 << 20
+_VMEM_BUDGET_BYTES = 13 << 20
 _MIN_ROWS = 32
+
+
+def _block_bytes(rows: int, width: int, itemsize: int) -> int:
+    # in + out refs at the input dtype, plus the kernel's f32 working set
+    # (ReLU'd values + bitcast patterns)
+    return rows * width * (2 * itemsize + 8)
 
 
 def _block_rows(h_width: int, n_rows: int) -> int:
     rows = _TARGET_BLOCK_BYTES // (h_width * 4) // _MIN_ROWS * _MIN_ROWS
     rows = max(_MIN_ROWS, min(rows, 256))
+    # (no VMEM shrink needed here: rows > _MIN_ROWS implies width <= 8192 by
+    # the target-bytes formula, far under the budget — supported() is the
+    # single place the VMEM gate lives)
     # shrink to the smallest aligned block covering small inputs
     while rows - _MIN_ROWS >= n_rows and rows > _MIN_ROWS:
         rows -= _MIN_ROWS
@@ -67,6 +87,10 @@ def supported(h: jax.Array, k: int) -> bool:
         and width >= 256
         and 0 < k < width
         and h.dtype in (jnp.float32, jnp.bfloat16)
+        # a full-speed (>=32-row) block must fit the VMEM working-set
+        # budget; narrower fallback blocks are slower than the dense path
+        and _block_bytes(_MIN_ROWS, width, jnp.dtype(h.dtype).itemsize)
+        <= _VMEM_BUDGET_BYTES
     )
 
 

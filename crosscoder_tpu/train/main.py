@@ -35,7 +35,7 @@ def build_buffer(cfg: CrossCoderConfig, mesh) -> tuple[Any, CrossCoderConfig]:
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from crosscoder_tpu.data.buffer import PairedActivationBuffer
+    from crosscoder_tpu.data.buffer import make_buffer
     from crosscoder_tpu.data.tokens import load_pile_lmsys_mixed_tokens
     from crosscoder_tpu.models import lm
 
@@ -49,7 +49,7 @@ def build_buffer(cfg: CrossCoderConfig, mesh) -> tuple[Any, CrossCoderConfig]:
     params_list = [lm.from_hf(n, lm_cfg)[0] for n in names]
     cfg = cfg.replace(d_in=lm_cfg.d_model)
     tokens = load_pile_lmsys_mixed_tokens(cfg)
-    buffer = PairedActivationBuffer(
+    buffer = make_buffer(
         cfg, lm_cfg, params_list, tokens,
         batch_sharding=NamedSharding(mesh, P("data", None)),
         lazy=cfg.resume,   # resume restores calibration + refills once, in restore()

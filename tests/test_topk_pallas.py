@@ -77,3 +77,19 @@ def test_gradient_parity_at_exact_zero_survivors():
     g_dense = jax.grad(lambda x: _dense(x, 4).sum())(h)
     np.testing.assert_array_equal(np.asarray(g_pallas), np.asarray(g_dense))
     assert int((np.asarray(g_dense) != 0).sum()) == 1  # only the 3.0 entry
+
+
+def test_supported_gates_wide_dicts():
+    """Widths whose 32-row block exceeds the VMEM working-set budget are
+    rejected (measured on v5e: 2^16+ either fails to compile or runs slower
+    than the dense path) — dispatch must fall back to dense, not crash."""
+    import jax
+
+    from crosscoder_tpu.ops import topk_pallas as tp
+
+    ok_bf16 = jax.ShapeDtypeStruct((4096, 2**15), jnp.bfloat16)
+    wide_bf16 = jax.ShapeDtypeStruct((4096, 2**16), jnp.bfloat16)
+    wider = jax.ShapeDtypeStruct((4096, 2**17), jnp.bfloat16)
+    assert tp.supported(ok_bf16, 32)
+    assert not tp.supported(wide_bf16, 32)
+    assert not tp.supported(wider, 32)
