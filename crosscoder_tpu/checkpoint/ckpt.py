@@ -55,8 +55,11 @@ class Checkpointer:
 
     @staticmethod
     def _flatten(tree: Any) -> dict[str, np.ndarray]:
-        leaves = jax.tree_util.tree_leaves(tree)
-        return {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+        # leaves are keyed by their PYTREE PATH, not position: a reordering
+        # of optax's internal state fields then fails loudly on restore
+        # (path mismatch) instead of silently loading moments into params
+        paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+        return {jax.tree_util.keystr(p): np.asarray(leaf) for p, leaf in paths}
 
     # --- save ---------------------------------------------------------------
     def save(self, state: Any, cfg: CrossCoderConfig, buffer: Any | None = None) -> Path:
@@ -126,19 +129,29 @@ class Checkpointer:
         vdir = Path(version_dir) if version_dir else self.latest_version_dir(self.base_dir)
         v = self.latest_save(vdir) if save is None else save
         template = init_train_state(jax.random.key(cfg.seed), cfg, tx)
-        leaves, treedef = jax.tree_util.tree_flatten(template)
+        pathed, treedef = jax.tree_util.tree_flatten_with_path(template)
         with np.load(vdir / f"{v}_train_state.npz") as z:
-            if len(z.files) != len(leaves):
+            if len(z.files) != len(pathed):
                 raise ValueError(
-                    f"checkpoint has {len(z.files)} leaves but state expects {len(leaves)}; "
+                    f"checkpoint has {len(z.files)} leaves but state expects {len(pathed)}; "
                     "optimizer chain or model shape changed since save"
                 )
-            loaded = [
-                jax.numpy.asarray(z[f"leaf_{i}"], dtype=leaves[i].dtype) for i in range(len(leaves))
-            ]
-        for i, (a, b) in enumerate(zip(loaded, leaves)):
+            positional = all(k.startswith("leaf_") for k in z.files)
+            loaded = []
+            for i, (path, leaf) in enumerate(pathed):
+                key = f"leaf_{i}" if positional else jax.tree_util.keystr(path)
+                if key not in z.files:
+                    raise ValueError(
+                        f"checkpoint is missing state leaf {key!r}; optimizer "
+                        "chain changed since save (leaves are path-keyed)"
+                    )
+                loaded.append(jax.numpy.asarray(z[key], dtype=leaf.dtype))
+        for (path, b), a in zip(pathed, loaded):
             if a.shape != b.shape:
-                raise ValueError(f"leaf {i}: checkpoint shape {a.shape} != expected {b.shape}")
+                raise ValueError(
+                    f"leaf {jax.tree_util.keystr(path)}: checkpoint shape "
+                    f"{a.shape} != expected {b.shape}"
+                )
         state = jax.tree_util.tree_unflatten(treedef, loaded)
         meta = json.loads((vdir / f"{v}_meta.json").read_text())
         # continue versioning in the same dir, after the restored save

@@ -80,6 +80,11 @@ class CrossCoderConfig:
     jumprelu_bandwidth: float = 0.001  # STE bandwidth for the threshold gradient
     data_axis_size: int = -1        # -1: all remaining devices on the data axis
     model_axis_size: int = 1        # tensor-parallel shards of the dict axis
+    shard_sources: bool = False     # EP-style: shard the SOURCE axis
+                                    # (n_models × n_hooked_layers) over the
+                                    # 'model' mesh axis instead of the dict
+                                    # axis — for many-model/many-layer diffs;
+                                    # n_sources must divide by model_axis_size
     buffer_device: str = "host"     # replay store placement: host RAM (big
                                     # buffers, multi-host, analysis reads)
                                     # | "hbm" (single-chip: zero host↔device
@@ -130,6 +135,12 @@ class CrossCoderConfig:
             raise ValueError(f"data_source must be 'gemma' or 'synthetic', got {self.data_source!r}")
         if self.master_dtype not in ("fp32", "bf16"):
             raise ValueError(f"master_dtype must be fp32 or bf16, got {self.master_dtype!r}")
+        if (self.shard_sources and self.model_axis_size > 1
+                and self.n_sources % self.model_axis_size != 0):
+            raise ValueError(
+                f"shard_sources: n_sources {self.n_sources} must divide by "
+                f"model_axis_size {self.model_axis_size}"
+            )
         if self.buffer_device not in ("host", "hbm"):
             raise ValueError(
                 f"buffer_device must be 'host' or 'hbm', got {self.buffer_device!r}"

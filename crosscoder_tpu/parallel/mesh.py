@@ -39,7 +39,27 @@ _PARAM_SPECS: dict[str, P] = {
     "log_theta": P("model"),
 }
 
+# EP-style alternative (cfg.shard_sources, component N4 as a sharding mode):
+# the SOURCE axis (n_models × n_hooked_layers) shards over the 'model' mesh
+# axis instead of the dict axis — each device holds whole models'/layers'
+# encoder/decoder slabs. The encode einsum contracts the source axis, so
+# XLA inserts a psum over 'model' for the pre-activations; decode outputs
+# come back source-sharded and the per-source reductions stay local. The
+# right trade when n_sources is large (many-model diffs / many hooked
+# layers) and the dictionary is small enough to replicate.
+_SOURCE_SPECS: dict[str, P] = {
+    "W_enc": P("model", None, None),
+    "W_dec": P(None, "model", None),
+    "b_enc": P(None),              # latent-axis params replicate in this mode
+    "b_dec": P("model", None),
+    "log_theta": P(None),
+}
+
 BATCH_SPEC = P("data", None, None)
+
+
+def _specs(shard_sources: bool = False) -> dict[str, P]:
+    return _SOURCE_SPECS if shard_sources else _PARAM_SPECS
 
 
 def make_mesh(
@@ -73,15 +93,17 @@ def mesh_from_cfg(cfg) -> Mesh:
     return make_mesh(cfg.data_axis_size, cfg.model_axis_size)
 
 
-def param_spec(name: str) -> P:
+def param_spec(name: str, shard_sources: bool = False) -> P:
     try:
-        return _PARAM_SPECS[name]
+        return _specs(shard_sources)[name]
     except KeyError:
         raise ValueError(f"no sharding rule for param {name!r}") from None
 
 
-def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, NamedSharding]:
-    return {k: NamedSharding(mesh, param_spec(k)) for k in params}
+def param_shardings(
+    mesh: Mesh, params: dict[str, Any], shard_sources: bool = False
+) -> dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, param_spec(k, shard_sources)) for k in params}
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -89,7 +111,7 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, BATCH_SPEC)
 
 
-def state_shardings(mesh: Mesh, state: Any) -> Any:
+def state_shardings(mesh: Mesh, state: Any, shard_sources: bool = False) -> Any:
     """Shardings for a full TrainState pytree (params + optimizer state + step).
 
     Optimizer moments mirror their parameter's sharding; anything that is not
@@ -99,19 +121,20 @@ def state_shardings(mesh: Mesh, state: Any) -> Any:
     special-casing optax internals.
     """
     replicated = NamedSharding(mesh, P())
+    specs = _specs(shard_sources)
 
     def spec_of(path, leaf) -> NamedSharding:
         for entry in reversed(path):
             key = getattr(entry, "key", None)
-            if key in _PARAM_SPECS:
-                if hasattr(leaf, "ndim") and leaf.ndim == len(_PARAM_SPECS[key]):
-                    return NamedSharding(mesh, _PARAM_SPECS[key])
+            if key in specs:
+                if hasattr(leaf, "ndim") and leaf.ndim == len(specs[key]):
+                    return NamedSharding(mesh, specs[key])
                 return replicated
         return replicated
 
     return jax.tree_util.tree_map_with_path(spec_of, state)
 
 
-def shard_state(mesh: Mesh, state: Any) -> Any:
+def shard_state(mesh: Mesh, state: Any, shard_sources: bool = False) -> Any:
     """Place a host-built TrainState onto the mesh per the rules above."""
-    return jax.device_put(state, state_shardings(mesh, state))
+    return jax.device_put(state, state_shardings(mesh, state, shard_sources))

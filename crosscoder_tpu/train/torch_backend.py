@@ -116,7 +116,7 @@ class TorchTrainer:
         self.sched.step()
         self.opt.zero_grad()
         out = {
-            "loss": float(loss),
+            "loss": float(loss.detach()),
             "l2_loss": float(losses["l2_loss"]),
             "l1_loss": float(losses["l1_loss"]),
             "l0_loss": float(losses["l0_loss"]),

@@ -149,7 +149,7 @@ class Trainer:
 
         self._tx = tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
         state = init_train_state(jax.random.key(cfg.seed), cfg, tx)
-        self._state_shardings = mesh_lib.state_shardings(self.mesh, state)
+        self._state_shardings = mesh_lib.state_shardings(self.mesh, state, cfg.shard_sources)
         self.state = jax.device_put(state, self._state_shardings)
         self._step_fn = make_train_step(cfg, self.mesh, tx, self._state_shardings)
         self._step_fn_bare = None   # compiled on first off-log-step use

@@ -119,3 +119,66 @@ def test_step_time_in_logs(tmp_path):
     trainer.train(10)
     lines = [json.loads(l) for l in (tmp_path / "metrics.jsonl").read_text().splitlines()]
     assert all("step_time_ms" in l and l["step_time_ms"] > 0 for l in lines)
+
+
+def test_shard_sources_matches_dict_sharding():
+    """EP-style source-axis sharding (cfg.shard_sources): a 2x4 mesh with
+    W_enc/W_dec sharded over the SOURCE axis must produce the same training
+    trajectory as the default dict-axis TP sharding — XLA's psum over the
+    contracted source axis replaces the latent-axis collectives."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from crosscoder_tpu.config import CrossCoderConfig
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train.trainer import make_train_step
+
+    # 4 sources: 2 models × 2 hook points — the many-source regime the mode
+    # exists for; model axis 4 puts one source slab per device
+    def cfg_for(shard_sources):
+        return CrossCoderConfig(
+            d_in=16, dict_size=64, n_models=2,
+            hook_points=("blocks.1.hook_resid_pre", "blocks.2.hook_resid_pre"),
+            batch_size=32, enc_dtype="fp32", model_axis_size=4,
+            data_axis_size=2, shard_sources=shard_sources, log_backend="null",
+        )
+
+    mesh = mesh_lib.make_mesh(data_axis_size=2, model_axis_size=4)
+    batch = jax.device_put(
+        jax.random.normal(jax.random.key(1), (32, 4, 16), dtype=jnp.float32),
+        mesh_lib.batch_sharding(mesh),
+    )
+    scale = jnp.ones((4,), jnp.float32)
+
+    losses = {}
+    for mode in (False, True):
+        cfg = cfg_for(mode)
+        tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+        state = init_train_state(jax.random.key(cfg.seed), cfg, tx)
+        sh = mesh_lib.state_shardings(mesh, state, mode)
+        state = jax.device_put(state, sh)
+        step = make_train_step(cfg, mesh, tx, sh)
+        track = []
+        for _ in range(3):
+            state, m = step(state, batch, scale)
+            track.append(float(jax.device_get(m["loss"])))
+        losses[mode] = track
+        # the intended placement actually happened
+        w_enc_sh = state.params["W_enc"].sharding.spec
+        if mode:
+            assert w_enc_sh[0] == "model", w_enc_sh
+        else:
+            assert w_enc_sh[2] == "model", w_enc_sh
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5)
+
+
+def test_shard_sources_validation():
+    import pytest as _pytest
+
+    from crosscoder_tpu.config import CrossCoderConfig
+
+    with _pytest.raises(ValueError, match="must divide"):
+        CrossCoderConfig(n_models=3, model_axis_size=2, shard_sources=True)

@@ -136,3 +136,40 @@ def test_meta_records_step_and_buffer(tmp_path):
     meta = json.loads((tmp_path / "version_0" / "0_meta.json").read_text())
     assert meta["step"] == 3
     assert meta["buffer"] == {"counter": 3}
+
+
+def test_restore_rejects_reordered_optimizer_state(tmp_path):
+    """Train-state leaves are PATH-keyed in the checkpoint: restoring with a
+    different optimizer chain (same leaf count/shapes, different structure)
+    fails loudly instead of silently pairing moments with the wrong slots."""
+    import optax
+    import pytest
+
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train import schedules
+
+    cfg = CrossCoderConfig(d_in=8, dict_size=16, checkpoint_dir=str(tmp_path),
+                           enc_dtype="fp32")
+    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    state = init_train_state(jax.random.key(0), cfg, tx)
+    ck = Checkpointer(cfg=cfg)
+    ck.save(state, cfg)
+    vdir = Checkpointer.latest_version_dir(tmp_path)
+
+    # SAME leaf count and shapes, different pytree paths: the optimizer
+    # chain reordered (adam state at chain index 0 instead of 1). The old
+    # positional pairing would silently load moments into the wrong slots;
+    # path-keyed pairing must refuse.
+    lr_fn = schedules.lr_schedule(cfg)
+    tx2 = optax.chain(
+        optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2, eps=1e-8),
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.scale_by_learning_rate(lr_fn),
+    )
+    from crosscoder_tpu.train.state import init_train_state as _init
+    n1 = len(jax.tree_util.tree_leaves(_init(jax.random.key(0), cfg, tx)))
+    n2 = len(jax.tree_util.tree_leaves(_init(jax.random.key(0), cfg, tx2)))
+    assert n1 == n2, "reordered chain must keep the leaf count equal"
+    ck2 = Checkpointer(cfg=cfg)
+    with pytest.raises(ValueError, match="missing state leaf"):
+        ck2.restore(cfg, tx2, version_dir=vdir)
