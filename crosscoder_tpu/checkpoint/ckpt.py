@@ -16,9 +16,11 @@ that walks reference checkpoints finds the same shape (reference
   ``Checkpointer.restore`` rebuilds the exact TrainState.
 
 Restore rebuilds the pytree by flattening a freshly-initialized state with
-the same cfg/optimizer and pairing leaves positionally — no pickled
-treedefs, so checkpoints stay readable across refactors of optax internals
-as long as the optimizer chain is unchanged.
+the same cfg/optimizer and pairing leaves BY PYTREE PATH (keys like
+``.params['W_enc']`` in the npz) — no pickled treedefs, so checkpoints stay
+readable across refactors, and a changed/reordered optimizer chain fails
+loudly on a missing path instead of silently loading moments into the
+wrong slots. Old positional (``leaf_i``) saves still load.
 """
 
 from __future__ import annotations

@@ -10,11 +10,11 @@ idiomatic JAX recipe: one explicit 2-axis ``Mesh``
   of ``W_enc``/``W_dec``/``b_enc`` — L1/L0 latent reductions become XLA
   psums over the shard axis (component N3).
 
-The crosscoder's source axis (``n_models``/layers) is small (2-6) and kept
-replicated; the per-source decoder norms and EVs are cheap. Scaling the
-source axis (component N4) rides the same `model` axis by sharding
-``d_hidden`` — each shard still sees every source, which the tied encoder
-einsum requires.
+The crosscoder's source axis (``n_models``/layers) is replicated by
+default (small, 2-6). For many-model/many-layer diffs the source axis can
+instead be the sharded one (component N4): ``cfg.shard_sources`` switches
+to ``_SOURCE_SPECS`` below — whole per-source slabs per device, with XLA
+psumming the contracted source axis in encode.
 
 Multi-host: ``jax.distributed.initialize`` + the same mesh over
 ``jax.devices()`` spanning hosts; XLA routes ICI within a slice and DCN
