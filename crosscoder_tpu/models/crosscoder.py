@@ -169,12 +169,12 @@ def cached_apply(cfg: CrossCoderConfig, kind: str = "forward"):
 #
 # Measured guidance (TPU v5e, k 32, batch 4096, full train step —
 # artifacts/BENCH_r02_local.json matrix): at dict 2^15 the DENSE decode
-# wins (77.1 vs 94.9 ms/step) because at B·k/H ≈ 4 hits per latent every
+# wins (78.16 vs 99.66 ms/step) because at B·k/H ≈ 4 hits per latent every
 # W_dec row is read anyway, the dense matmul is a compute-bound MXU op,
 # and XLA's row gather runs well below HBM bandwidth. The crossover lands
 # at dict 2^17 where the dense matmul's FLOPs dominate and this path wins
-# (252.0 vs 281.0 ms/step); at 2^16 they are within noise (159.4 vs
-# 156.3, dense slightly ahead). Default stays cfg.sparse_decode=False;
+# (255.93 vs 283.21 ms/step); at 2^16 they are within noise (160.62 vs
+# 156.77, dense slightly ahead). Default stays cfg.sparse_decode=False;
 # flip it at 2^17+.
 
 

@@ -59,6 +59,9 @@ def build_buffer(cfg: CrossCoderConfig, mesh) -> tuple[Any, CrossCoderConfig]:
 
 def main(argv: list[str] | None = None) -> Trainer:
     from crosscoder_tpu.parallel import multihost
+    from crosscoder_tpu.utils import compile_cache
+
+    compile_cache.enable()   # warm restarts/resumes skip remote recompiles
 
     distributed = multihost.initialize()   # no-op single-process
     cfg = CrossCoderConfig.from_cli(argv)

@@ -1,0 +1,34 @@
+"""Persistent XLA compilation cache for entry points.
+
+Remote-compile latency dominates cold starts on tunneled TPU clients
+(~30-60 s per program); the persistent cache turns restarts, resumes, and
+repeated bench/eval runs into warm starts (measured with the axon plugin:
+41.5 s cold → 3.0 s warm for a single jit). Library code never sets this —
+only executables opt in, so embedding applications keep control.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Default: ``$JAX_COMPILE_CACHE`` if set (empty string disables), else
+    ``.jax_cache/`` next to the repo root. Returns the directory used, or
+    ``None`` when disabled. Safe to call before or after backend init.
+    """
+    import jax
+
+    if cache_dir is None:
+        env = os.environ.get("JAX_COMPILE_CACHE")
+        if env == "":
+            return None
+        cache_dir = env or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir
