@@ -1,0 +1,27 @@
+"""Tests for the persistent-compile-cache helper
+(crosscoder_tpu/utils/compile_cache.py)."""
+
+import jax
+import pytest
+
+
+def test_compile_cache_enable(tmp_path, monkeypatch):
+    """compile_cache.enable(): explicit dir, env override, env-empty disable;
+    process-global jax config restored whatever happens."""
+    from crosscoder_tpu.utils import compile_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        d = compile_cache.enable(str(tmp_path / "cc"))
+        assert d == str(tmp_path / "cc")
+        monkeypatch.setenv("JAX_COMPILE_CACHE", str(tmp_path / "env"))
+        assert compile_cache.enable() == str(tmp_path / "env")
+        monkeypatch.setenv("JAX_COMPILE_CACHE", "")
+        assert compile_cache.enable() is None
+        monkeypatch.delenv("JAX_COMPILE_CACHE")
+        # default lands inside the repo
+        assert compile_cache.enable().endswith(".jax_cache")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)

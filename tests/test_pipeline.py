@@ -1,0 +1,69 @@
+"""Unit tests for the bounded in-flight pipeline driver — the shared
+machinery under buffer refresh, norm calibration, dashboard harvest, and
+the CE eval (crosscoder_tpu/utils/pipeline.py)."""
+
+import pytest
+
+from crosscoder_tpu.utils import pipeline
+
+
+def test_fifo_order_and_completeness():
+    out = []
+    pipeline.drive(iter(range(10)), out.append, depth=3)
+    assert out == list(range(10))
+
+
+def test_depth_bounds_in_flight():
+    """At most `depth` items are produced-but-undrained at any moment."""
+    live = 0
+    peak = 0
+
+    def produced():
+        nonlocal live, peak
+        for i in range(20):
+            live += 1
+            peak = max(peak, live)
+            yield i
+
+    def drain(_):
+        nonlocal live
+        live -= 1
+
+    pipeline.drive(produced(), drain, depth=3)
+    assert live == 0
+    assert peak == 3
+
+
+def test_drain_lag():
+    """Item i is drained only after item i+depth-1 was produced (the lag
+    that lets device work overlap host work)."""
+    events = []
+    pipeline.drive(
+        (events.append(("p", i)) or i for i in range(6)),
+        lambda i: events.append(("d", i)),
+        depth=2,
+    )
+    assert events.index(("d", 0)) > events.index(("p", 1))
+    assert events.index(("d", 4)) > events.index(("p", 5))
+
+
+@pytest.mark.parametrize("depth", [1, 2, 5])
+def test_serial_and_deep(depth):
+    out = []
+    pipeline.drive(iter("abc"), out.append, depth=depth)
+    assert out == list("abc")
+
+
+def test_empty_stream():
+    pipeline.drive(iter(()), lambda _: pytest.fail("drain on empty stream"))
+
+
+def test_producer_exception_propagates():
+    def produced():
+        yield 1
+        raise RuntimeError("boom")
+
+    drained = []
+    with pytest.raises(RuntimeError, match="boom"):
+        pipeline.drive(produced(), drained.append, depth=1)
+    assert drained == [1]   # FIFO items before the failure were drained
