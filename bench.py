@@ -245,6 +245,9 @@ def section_e2e() -> dict:
             norm_calib_batches=8, seq_len=1024,
             hook_point=f"blocks.{hook_layer}.hook_resid_pre",
             num_tokens=10**12, save_every=10**9, prefetch=True,
+            # 0.5 = reference-parity harvest:serve; lower trades data
+            # freshness for harvest FLOPs (see cfg.refill_frac)
+            refill_frac=float(os.environ.get("BENCH_REFILL_FRAC", 0.5)),
         )
     n_dev = len(jax.devices())
     mesh = mesh_lib.make_mesh(data_axis_size=n_dev, model_axis_size=1)
@@ -313,6 +316,7 @@ def section_e2e() -> dict:
         "n_steps_measured": n_steps,
         "loss_finite": bool(jnp.isfinite(loss)),
         "buffer_device": buffer_device,
+        "refill_frac": cfg.refill_frac,
         "workload": (
             f"{shape_tag} pair → blocks.{hook_layer} harvest → {buffer_device} "
             f"buffer(mult {cfg.buffer_mult}) → train dict {cfg.dict_size}, "

@@ -100,6 +100,15 @@ class CrossCoderConfig:
     lr_decay_frac: float = 0.2      # linear lr decay over the last fraction (trainer.py:29-32)
     l1_warmup_frac: float = 0.05    # l1 warmup over the first fraction (trainer.py:36)
     norm_calib_batches: int = 100   # batches for norm calibration (buffer.py:45)
+    refill_frac: float = 0.5        # buffer fraction re-harvested per refill
+                                    # cycle. 0.5 = reference parity (1:1
+                                    # harvest:serve, buffer.py:70-74). Lower
+                                    # = each harvested row is served
+                                    # ~0.5/refill_frac times — harvest is
+                                    # ~2.4x the train step's FLOPs/row on
+                                    # TPU, so 0.25 raises end-to-end
+                                    # throughput ~1.4x at the cost of
+                                    # fresher-data churn.
     checkpoint_dir: str = "./checkpoints"
     data_dir: str = "./data"
     dataset_name: str = "ckkissane/pile-lmsys-mix-1m-tokenized-gemma-2"
@@ -140,6 +149,11 @@ class CrossCoderConfig:
             raise ValueError(
                 f"shard_sources: n_sources {self.n_sources} must divide by "
                 f"model_axis_size {self.model_axis_size}"
+            )
+        if not (0.0 < self.refill_frac <= 0.5):
+            raise ValueError(
+                f"refill_frac must be in (0, 0.5] (0.5 = reference parity; "
+                f"the serve trigger fires at half-buffer), got {self.refill_frac}"
             )
         if self.buffer_device not in ("host", "hbm"):
             raise ValueError(

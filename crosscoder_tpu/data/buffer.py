@@ -22,9 +22,10 @@ Behavioral parity with the reference (each a deliberate keep, SURVEY.md §2
 
 - sizes: ``buffer_size = batch_size·buffer_mult`` rounded DOWN to a multiple
   of ``seq_len−1`` (BOS rows are dropped; reference ``buffer.py:15-17,93``);
-- first ``refresh()`` fills the whole buffer, later ones refill only the
-  first half, so ~half of served rows are survivors of earlier refreshes
-  (reference ``buffer.py:70-74``);
+- first ``refresh()`` fills the whole buffer, later ones refill a
+  ``cfg.refill_frac`` fraction (default 0.5 — the reference's half-refill,
+  ``buffer.py:70-74``; smaller fractions re-serve survivors more, trading
+  data freshness for harvest FLOPs);
 - ``next()`` triggers a refresh once the read pointer passes
   ``buffer_size//2 − batch_size`` (reference ``buffer.py:121``);
 - per-source norm calibration ``sqrt(d_in)/mean_token_norm`` over
@@ -162,6 +163,14 @@ class PairedActivationBuffer:
             (self.buffer_size, self.cfg.n_sources, self.cfg.d_in), dtype=_BF16
         )
 
+    def _refill_batches(self) -> int:
+        """Sequences harvested per steady-state cycle. refill_frac 0.5 is
+        the reference's half-refill (buffer.py:70-74); smaller fractions
+        re-serve survivors more (~0.5/refill_frac serves per harvested row)
+        and cut harvest FLOPs proportionally — the serve trigger stays at
+        the reference's half-buffer point either way."""
+        return max(1, int(self.buffer_batches * self.cfg.refill_frac))
+
     # ------------------------------------------------------------------
     # harvest
 
@@ -255,14 +264,17 @@ class PairedActivationBuffer:
     def refresh(self) -> None:
         """Synchronous refill: first fill, resume, and tests.
 
-        First call fills the whole buffer; later calls refill half (reference
-        ``buffer.py:70-74``). Steady-state training does NOT come through
+        First call fills the whole buffer; later calls refill
+        ``cfg.refill_frac`` of it (0.5 = the reference's half-refill,
+        reference ``buffer.py:70-74``). Steady-state training does NOT come through
         here — the serve path refills *incrementally*, interleaving harvest
         chunks between train steps (see :meth:`_advance_cycle`), so the
         reference's multi-second stall every ~63 steps (reference
         ``buffer.py:121-122``) becomes a sub-batch-sized bubble.
         """
-        num_batches = self.buffer_batches if self.first else self.buffer_batches // 2
+        num_batches = (
+            self.buffer_batches if self.first else self._refill_batches()
+        )
         self.first = False
         self._begin_cycle(num_batches)
         self._finish_cycle()
@@ -303,7 +315,7 @@ class PairedActivationBuffer:
             self._global_seq -= dropped
             self._cyc_inflight = []
         if num_batches is None:
-            num_batches = self.buffer_batches // 2
+            num_batches = self._refill_batches()
         b = self.cfg.batch_size
         trigger = self.buffer_size // 2 - b
         served_at_finish = (trigger // b + 1) * b
