@@ -1,0 +1,55 @@
+import os, time
+import jax, jax.numpy as jnp
+import numpy as np
+from crosscoder_tpu.utils import compile_cache
+compile_cache.enable()
+from jax.sharding import NamedSharding, PartitionSpec as P
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data.buffer import make_buffer
+from crosscoder_tpu.models import lm
+from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.train.trainer import Trainer
+from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+
+hook_layer = 14
+full = lm.LMConfig.gemma2_2b()
+lm_cfg = full.replace(n_layers=hook_layer)
+cfg = CrossCoderConfig(
+    batch_size=4096, buffer_mult=32, model_batch_size=4, norm_calib_batches=4,
+    seq_len=1024, hook_point=f"blocks.{hook_layer}.hook_resid_pre",
+    num_tokens=10**12, save_every=10**9, prefetch=True, enc_dtype="bf16",
+    master_dtype="bf16", dict_size=2**15, log_backend="null",
+    buffer_device="hbm", refill_frac=0.5, checkpoint_dir="/tmp/soak_ck",
+)
+mesh = mesh_lib.make_mesh(data_axis_size=1, model_axis_size=1)
+params = [lm.init_params(jax.random.key(i), lm_cfg) for i in (0, 1)]
+rng = np.random.default_rng(0)
+tokens = rng.integers(0, lm_cfg.vocab_size, size=(2048, 1024), dtype=np.int32)
+buf = make_buffer(cfg, lm_cfg, params, tokens,
+                  batch_sharding=NamedSharding(mesh, P("data", None)))
+tr = Trainer(cfg, buf, mesh=mesh, checkpointer=Checkpointer(cfg=cfg))
+m = tr.step(); print("first loss", float(jax.device_get(m["loss"])), flush=True)
+
+N = 500
+t0 = time.perf_counter()
+for i in range(N):
+    m = tr.step(full_metrics=(i % 100 == 0))
+    if i % 100 == 0:
+        print(f"step {i}: loss {float(jax.device_get(m['loss'])):.4f} "
+              f"({(time.perf_counter()-t0):.0f}s)", flush=True)
+loss_end = float(jax.device_get(m["loss"]))
+dt = time.perf_counter() - t0
+print(f"soak: {N} steps in {dt:.0f}s -> {cfg.batch_size*N/dt:.0f} acts/s; final loss {loss_end:.4f}", flush=True)
+
+print("checkpoint + restore ...", flush=True)
+tr.save()
+tr2_buf = make_buffer(cfg, lm_cfg, params, tokens,
+                      batch_sharding=NamedSharding(mesh, P("data", None)), lazy=True)
+tr2 = Trainer(cfg, tr2_buf, mesh=mesh, checkpointer=Checkpointer(cfg=cfg))
+meta = tr2.restore()
+print("restored at step", meta["step"], flush=True)
+for _ in range(10):
+    m = tr2.step()
+print("post-restore loss", float(jax.device_get(m["loss"])), flush=True)
+tr.close(); tr2.close()
+print("SOAK OK", flush=True)

@@ -147,7 +147,16 @@ class Checkpointer:
                         f"checkpoint is missing state leaf {key!r}; optimizer "
                         "chain changed since save (leaves are path-keyed)"
                     )
-                loaded.append(jax.numpy.asarray(z[key], dtype=leaf.dtype))
+                raw = z[key]
+                want = np.dtype(leaf.dtype)
+                # npz stores extension dtypes (bf16 and friends from
+                # ml_dtypes) as raw void bytes; reinterpret against the
+                # template's dtype — without this, bf16-master checkpoints
+                # save fine but cannot restore ("No cast function available")
+                if (raw.dtype.kind == "V" and raw.dtype != want
+                        and raw.dtype.itemsize == want.itemsize):
+                    raw = raw.view(want)
+                loaded.append(jax.numpy.asarray(raw, dtype=leaf.dtype))
         for (path, b), a in zip(pathed, loaded):
             if a.shape != b.shape:
                 raise ValueError(

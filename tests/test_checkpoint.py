@@ -173,3 +173,28 @@ def test_restore_rejects_reordered_optimizer_state(tmp_path):
     ck2 = Checkpointer(cfg=cfg)
     with pytest.raises(ValueError, match="missing state leaf"):
         ck2.restore(cfg, tx2, version_dir=vdir)
+
+
+def test_bf16_master_checkpoint_roundtrip(tmp_path):
+    """master_dtype='bf16' (the reference's exact dtype regime): npz stores
+    bf16 leaves as raw void bytes, which restore must reinterpret — round-1
+    code saved fine but failed to restore ('No cast function available'),
+    caught by the round-2 hardware soak."""
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+
+    cfg = CrossCoderConfig(d_in=8, dict_size=16, checkpoint_dir=str(tmp_path),
+                           enc_dtype="bf16", master_dtype="bf16")
+    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    state = init_train_state(jax.random.key(0), cfg, tx)
+    assert state.params["W_enc"].dtype == jax.numpy.bfloat16
+    ck = Checkpointer(cfg=cfg)
+    ck.save(state, cfg)
+    vdir = Checkpointer.latest_version_dir(tmp_path)
+    ck2 = Checkpointer(cfg=cfg)
+    restored, meta = ck2.restore(cfg, tx, version_dir=vdir)
+    assert restored.params["W_enc"].dtype == jax.numpy.bfloat16
+    for a, b in zip(jax.tree_util.tree_leaves(restored),
+                    jax.tree_util.tree_leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
