@@ -161,10 +161,59 @@ def section_step() -> dict:
     return out
 
 
+def _kernel_parity(dict_size: int) -> dict:
+    """On-DEVICE parity asserts (VERDICT round-2 weak #4: CI runs the
+    Pallas interpreter; a Mosaic miscompile producing plausible garbage
+    would pass ``loss_finite``). Executed on the live backend right before
+    the timed variants:
+
+    - pallas TopK output == dense ``lax.top_k`` scatter, bit-exact;
+    - sparse-decode loss == dense-decode loss (same math re-associated, so
+      tolerance is a few fp32 ulps, max-abs-diff recorded).
+    """
+    import numpy as np
+
+    from crosscoder_tpu.models import crosscoder as cc
+    from crosscoder_tpu.ops import activations as act_ops
+    from crosscoder_tpu.ops import topk_pallas
+
+    k = 32
+    h = jax.random.normal(jax.random.key(7), (256, dict_size), jnp.bfloat16)
+    if not topk_pallas.supported(h, k):
+        # unsupported width ≠ miscompile: report the skip, not a failure
+        return {"dict_size": dict_size,
+                "skipped": "kernel unsupported at this width"}
+    out_p = jax.jit(lambda x: topk_pallas.topk(x, k))(h)
+    out_d = jax.jit(lambda x: act_ops._topk_dense(x, k))(h)
+    topk_ok = bool(jax.device_get(jax.jit(lambda a, b: (a == b).all())(out_p, out_d)))
+
+    cfg_d = _make_cfg(dict_size=dict_size, activation="topk", topk_k=k,
+                      l1_coeff=0.0, batch_size=256)
+    cfg_s = cfg_d.replace(sparse_decode=True)
+    params = cc.init_params(jax.random.key(3), cfg_d)
+    x = jax.random.normal(jax.random.key(8), (256, cfg_d.n_sources, cfg_d.d_in),
+                          jnp.bfloat16)
+    l_d = jax.jit(lambda p, b: cc.get_losses(p, b, cfg_d).l2_loss)(params, x)
+    l_s = jax.jit(lambda p, b: cc.get_losses(p, b, cfg_s).l2_loss)(params, x)
+    l_d, l_s = float(jax.device_get(l_d)), float(jax.device_get(l_s))
+    denom = max(abs(l_d), 1e-30)
+    sparse_rel = abs(l_s - l_d) / denom
+    entry = {
+        "dict_size": dict_size,
+        "topk_pallas_bitexact": topk_ok,
+        "sparse_decode_l2_rel_diff": float(np.format_float_scientific(
+            sparse_rel, precision=3, unique=False)),
+        "parity_ok": bool(topk_ok and sparse_rel < 1e-4),
+    }
+    log(f"[parity] {entry}")
+    return entry
+
+
 def section_matrix() -> list[dict]:
     """The sparse tier, at the training-step level (VERDICT round-1: the
     in-code perf claims were unverifiable; BASELINE config 2 had no
-    measured number)."""
+    measured number). Includes the full activation zoo (VERDICT round-2
+    weak #6: jumprelu/batchtopk were implemented but never measured)."""
     from crosscoder_tpu.ops import activations as act_ops
 
     on_tpu = jax.default_backend() == "tpu"
@@ -175,6 +224,8 @@ def section_matrix() -> list[dict]:
         ("topk_sparse_decode",
          dict(activation="topk", topk_k=32, l1_coeff=0.0, sparse_decode=True),
          "auto"),
+        ("batchtopk", dict(activation="batchtopk", topk_k=32, l1_coeff=0.0), "auto"),
+        ("jumprelu", dict(activation="jumprelu", l1_coeff=0.0), "auto"),
     ]
     steps = int(os.environ.get("BENCH_MATRIX_STEPS", 12))
     dicts = tuple(
@@ -184,17 +235,25 @@ def section_matrix() -> list[dict]:
     )
     out = []
     for dict_size in dicts:
+        if on_tpu:
+            try:
+                out.append(_kernel_parity(dict_size))
+            except Exception as e:
+                out.append({"dict_size": dict_size, "parity_ok": False,
+                            "error": f"{type(e).__name__}: {str(e)[:200]}"})
         for label, overrides, impl in variants:
             if impl == "pallas":
                 from crosscoder_tpu.ops import topk_pallas
 
-                probe = jax.ShapeDtypeStruct((1, dict_size), jnp.bfloat16)
                 if not on_tpu:
                     continue           # interpret mode is not a benchmark
+                probe = jax.ShapeDtypeStruct((1, dict_size), jnp.bfloat16)
                 if not topk_pallas.supported(probe, 32):
+                    # custom BENCH_MATRIX_DICTS width outside both kernel
+                    # variants: don't silently time the dense fallback
+                    # under the pallas label
                     out.append({"variant": label, "dict_size": dict_size,
-                                "skipped": "kernel unsupported at this width "
-                                           "(VMEM gate; dense path is faster)"})
+                                "skipped": "kernel unsupported at this width"})
                     continue
             act_ops.set_topk_impl(impl)
             try:

@@ -72,7 +72,13 @@ class CrossCoderConfig:
     n_models: int = 2               # reference hardcodes 2 (crosscoder.py:32)
     hook_points: tuple[str, ...] = ()   # multi-layer crosscoder: several hooks per model
     activation: str = "relu"        # relu | topk | jumprelu | batchtopk
-    topk_k: int = 32                # k for (batch)topk activation
+    topk_k: int = 32                # k for (batch)topk activation. NB
+                                    # batchtopk keeps ALL entries tied at
+                                    # the global threshold, so its
+                                    # effective L0 can exceed k·batch when
+                                    # bf16 pre-acts tie there (topk proper
+                                    # breaks ties by index and keeps
+                                    # exactly k per row)
     sparse_decode: bool = False     # topk only: decode via the k active rows
                                     # (gather + custom-vjp) instead of the
                                     # dense [B,H]x[H,n,d] matmul
@@ -87,9 +93,11 @@ class CrossCoderConfig:
                                     # n_sources must divide by model_axis_size
     buffer_device: str = "host"     # replay store placement: host RAM (big
                                     # buffers, multi-host, analysis reads)
-                                    # | "hbm" (single-chip: zero host↔device
-                                    # row traffic — the reference's own
-                                    # placement, buffer.py:18-22)
+                                    # | "hbm": zero host↔device row traffic
+                                    # — the reference's own placement
+                                    # (buffer.py:18-22); on a multi-chip
+                                    # mesh the store shards over the data
+                                    # axis and serves batches pre-sharded
     seq_shards: int = 0             # >0: harvest forwards shard the SEQUENCE
                                     # axis over the mesh data axis (ring
                                     # attention), for contexts too long for
