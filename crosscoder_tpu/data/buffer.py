@@ -533,9 +533,16 @@ class PairedActivationBuffer:
 def make_buffer(cfg: CrossCoderConfig, lm_cfg, model_params, tokens,
                 **kwargs) -> "PairedActivationBuffer":
     """Construct the replay buffer per ``cfg.buffer_device`` (the single
-    selection point — host RAM vs HBM store, same semantics)."""
-    cls = (DevicePairedActivationBuffer if cfg.buffer_device == "hbm"
-           else PairedActivationBuffer)
+    selection point — host RAM vs HBM store, same semantics). An HBM store
+    on a multi-chip mesh shards over the ``data`` axis
+    (:class:`MeshPairedActivationBuffer`)."""
+    cls: type[PairedActivationBuffer] = PairedActivationBuffer
+    if cfg.buffer_device == "hbm":
+        bs = kwargs.get("batch_sharding")
+        if bs is not None and int(bs.mesh.shape.get("data", 1)) > 1:
+            cls = MeshPairedActivationBuffer
+        else:
+            cls = DevicePairedActivationBuffer
     return cls(cfg, lm_cfg, model_params, tokens, **kwargs)
 
 
@@ -584,10 +591,12 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
       one chunk-sized fetch per harvest chunk — nothing on a local PCIe/DMA
       link, but pathological through a remote-tunnel TPU client (~7 MB/s:
       the 75 MB/step round trip IS the step time).
-    - ``hbm``: single-chip/pod training where the buffer fits — the
+    - ``hbm``: training where the buffer fits device memory — the
       reference's own placement (its 4.8 GB buffer lives in GPU HBM,
       reference ``buffer.py:18-22``), minus its full-buffer ``randperm``
-      copies (index-permutation serving needs none).
+      copies (index-permutation serving needs none). On a multi-chip mesh
+      ``make_buffer`` picks :class:`MeshPairedActivationBuffer`, which
+      shards this store over the ``data`` axis.
     """
 
     def _alloc_store(self) -> None:
@@ -601,6 +610,23 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
         """Host view (tests/analysis only — fetches the whole store)."""
         return np.asarray(jax.device_get(self._store_dev))
 
+    # storage hooks the mesh-sharded subclass overrides -----------------
+
+    def _pad_limit(self) -> int:
+        """First index guaranteed out of range of the device store — pad
+        scatter positions start here so they are always dropped."""
+        return self.buffer_size
+
+    def _scatter_chunk(self, positions: np.ndarray, acts_dev: jax.Array) -> None:
+        self._store_dev = _dev_scatter(
+            self._store_dev, jnp.asarray(positions, jnp.int32), acts_dev
+        )
+
+    def _gather_rows(self, idx: np.ndarray) -> jax.Array:
+        return _dev_gather(self._store_dev, jnp.asarray(idx, jnp.int32))
+
+    # -------------------------------------------------------------------
+
     def _drain_one(self) -> None:
         cfg = self.cfg
         rows_per_seq = cfg.seq_len - 1
@@ -611,11 +637,9 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
             # unique out-of-range pad indices, dropped by the scatter
             positions = np.concatenate([
                 positions,
-                self.buffer_size + np.arange(pad_rows, dtype=positions.dtype),
+                self._pad_limit() + np.arange(pad_rows, dtype=positions.dtype),
             ])
-        self._store_dev = _dev_scatter(
-            self._store_dev, jnp.asarray(positions, jnp.int32), acts_dev
-        )
+        self._scatter_chunk(positions, acts_dev)
         self._src_global[positions[: n * rows_per_seq]] = np.repeat(
             seq_globals, rows_per_seq
         )
@@ -623,8 +647,7 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
 
     def next(self) -> jax.Array:
         """fp32 normalized batch, DEVICE-resident."""
-        idx = self._next_idx()
-        out = _dev_gather(self._store_dev, jnp.asarray(idx, jnp.int32))
+        out = self._gather_rows(self._next_idx())
         out = out.astype(jnp.float32) * jnp.asarray(
             self.normalisation_factor
         )[None, :, None]
@@ -634,7 +657,146 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
     def next_raw(self) -> jax.Array:
         """Raw bf16 batch, DEVICE-resident (the trainer's fast path — the
         step applies the norm factors on device)."""
-        idx = self._next_idx()
-        out = _dev_gather(self._store_dev, jnp.asarray(idx, jnp.int32))
+        out = self._gather_rows(self._next_idx())
         self._after_serve()
         return out
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded HBM variant
+
+
+@functools.lru_cache(maxsize=8)
+def _mesh_store_ops(mesh, rows_local: int, acts_sharded: bool):
+    """Compiled scatter/gather for a store sharded over the mesh ``data``
+    axis on its row dimension (shard d owns rows [d·rows_local, (d+1)·…)).
+
+    - *scatter*: every device sees the full position list (replicated) and —
+      after an ``all_gather`` of the harvest chunk's rows when the harvest
+      was batch-sharded — applies exactly the updates that land in its own
+      shard, via local indices with ``mode="drop"`` discarding the rest.
+      One chunk's rows (~38 MB at Gemma-2-2B shapes) ride ICI per refill
+      chunk; nothing goes through host.
+    - *gather* (the serve path): each device gathers its local hits, zeroes
+      the misses, and a ``psum_scatter`` over the batch axis leaves every
+      device holding exactly its batch shard, fully summed — the output IS
+      the train step's ``P('data', None, None)`` batch sharding, so serving
+      moves only (n_dev−1)/n_dev of one batch over ICI and nothing else.
+
+    Contributions are disjoint across devices (each global row lives in
+    exactly one shard), so the bf16 psum adds zeros — exact.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    acts_spec = P("data", None, None, None) if acts_sharded else P()
+
+    def scatter(store, positions, acts):
+        rows = acts[:, 1:].reshape(-1, acts.shape[2], acts.shape[3])
+        if acts_sharded:
+            rows = jax.lax.all_gather(rows, "data", axis=0, tiled=True)
+        my = jax.lax.axis_index("data")
+        local = positions - my * rows_local
+        # out-of-shard rows must be DROPPED, but jnp indexing wraps
+        # negative indices numpy-style before the OOB mode applies — remap
+        # them to UNIQUE indices past the shard end (unique because
+        # unique_indices=True + duplicate OOB indices is undefined)
+        oob = rows_local + jnp.arange(local.shape[0], dtype=local.dtype)
+        in_shard = (local >= 0) & (local < rows_local)
+        local = jnp.where(in_shard, local, oob)
+        return store.at[local].set(
+            rows.astype(store.dtype), mode="drop", unique_indices=True
+        )
+
+    def gather(store, idx):
+        my = jax.lax.axis_index("data")
+        li = idx - my * rows_local
+        inb = (li >= 0) & (li < rows_local)
+        rows = store[jnp.clip(li, 0, rows_local - 1)]
+        contrib = jnp.where(inb[:, None, None], rows, jnp.zeros_like(rows))
+        return jax.lax.psum_scatter(contrib, "data", scatter_dimension=0,
+                                    tiled=True)
+
+    scatter_jit = jax.jit(
+        shard_map(scatter, mesh=mesh,
+                  in_specs=(P("data", None, None), P(), acts_spec),
+                  out_specs=P("data", None, None)),
+        donate_argnums=0,
+    )
+    gather_jit = jax.jit(
+        shard_map(gather, mesh=mesh,
+                  in_specs=(P("data", None, None), P()),
+                  out_specs=P("data", None, None)),
+    )
+    return scatter_jit, gather_jit
+
+
+class MeshPairedActivationBuffer(DevicePairedActivationBuffer):
+    """HBM replay store **sharded over the mesh ``data`` axis** (round-3;
+    VERDICT round-2 missing #3: every multi-chip config silently fell back
+    to the one-process host path — the scaling story had no data plane).
+
+    Serve/refill/resume semantics are byte-identical to the host store:
+    the same permutation, cycle accounting, and provenance bookkeeping run
+    on host (inherited); only the row bytes move differently — they stay
+    distributed, each row resident on exactly one device, with the serve
+    gather emitting batches already in the train step's batch sharding
+    (see :func:`_mesh_store_ops`). Rows are padded up to a multiple of the
+    shard count; pad rows are never referenced by the serve permutation.
+    """
+
+    def _alloc_store(self) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg = self.cfg
+        if self.batch_sharding is None:
+            raise ValueError("MeshPairedActivationBuffer needs batch_sharding")
+        mesh = self.batch_sharding.mesh
+        n_shards = int(mesh.shape.get("data", 1))
+        if cfg.batch_size % n_shards:
+            raise ValueError(
+                f"batch_size {cfg.batch_size} must divide by the mesh data "
+                f"axis {n_shards} for the sharded-store serve path"
+            )
+        self._rows_local = -(-self.buffer_size // n_shards)
+        self._store_size = self._rows_local * n_shards
+        sharding = NamedSharding(mesh, P("data", None, None))
+        self._store_dev = jax.jit(
+            functools.partial(
+                jnp.zeros,
+                (self._store_size, cfg.n_sources, cfg.d_in),
+                jnp.bfloat16,
+            ),
+            out_shardings=sharding,
+        )()
+        # under seq-parallel harvest the data axis carries the sequence, so
+        # chunks arrive without a batch sharding — use the replicated-acts
+        # scatter variant there
+        acts_sharded = self._seq_mesh is None
+        self._acts_sharding = NamedSharding(
+            mesh,
+            P("data", None, None, None) if acts_sharded else P(),
+        )
+        self._scatter, self._gather = _mesh_store_ops(
+            mesh, self._rows_local, acts_sharded
+        )
+
+    @property
+    def _store(self) -> np.ndarray:
+        """Host view (tests/analysis only — fetches the whole store)."""
+        return np.asarray(jax.device_get(self._store_dev))[: self.buffer_size]
+
+    def _pad_limit(self) -> int:
+        # pad indices must clear the PADDED store so no shard keeps them
+        return self._store_size
+
+    def _scatter_chunk(self, positions: np.ndarray, acts_dev: jax.Array) -> None:
+        acts_dev = jax.device_put(acts_dev, self._acts_sharding)
+        self._store_dev = self._scatter(
+            self._store_dev, jnp.asarray(positions, jnp.int32), acts_dev
+        )
+
+    def _gather_rows(self, idx: np.ndarray) -> jax.Array:
+        """Serve gather; the result comes back in the step's batch
+        sharding (``P('data', None, None)``)."""
+        return self._gather(self._store_dev, jnp.asarray(idx, jnp.int32))
