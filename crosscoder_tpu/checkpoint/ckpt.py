@@ -56,23 +56,49 @@ class Checkpointer:
         self.save_dir.mkdir(parents=True)
 
     @staticmethod
-    def _flatten(tree: Any) -> dict[str, np.ndarray]:
+    def _fetch_global(leaf: Any) -> np.ndarray:
+        """Leaf → host numpy, safe on a multi-host mesh.
+
+        ``np.asarray`` on a sharded ``jax.Array`` whose shards live on
+        other processes' devices raises (the leaf is not fully
+        addressable); those leaves are assembled with a
+        ``process_allgather`` — a COLLECTIVE, so every process must reach
+        this call (``Trainer.save`` runs save on all processes and gates
+        only the file writes). Single-process arrays take the cheap path.
+        """
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+        return np.asarray(leaf)
+
+    @classmethod
+    def _flatten(cls, tree: Any) -> dict[str, np.ndarray]:
         # leaves are keyed by their PYTREE PATH, not position: a reordering
         # of optax's internal state fields then fails loudly on restore
         # (path mismatch) instead of silently loading moments into params
         paths = jax.tree_util.tree_flatten_with_path(tree)[0]
-        return {jax.tree_util.keystr(p): np.asarray(leaf) for p, leaf in paths}
+        return {jax.tree_util.keystr(p): cls._fetch_global(leaf) for p, leaf in paths}
 
     # --- save ---------------------------------------------------------------
     def save(self, state: Any, cfg: CrossCoderConfig, buffer: Any | None = None) -> Path:
-        """Write one versioned save; returns the weights path."""
-        if self.save_dir is None:
+        """Write one versioned save; returns the weights path.
+
+        EVERY process must call this on a multi-host mesh (the state fetch
+        is collective); only process 0 touches the filesystem.
+        """
+        # collective fetches first, identical order on all processes; each
+        # leaf crosses the network ONCE — the weights artifact reuses the
+        # already-flattened state's param leaves
+        flat_state = self._flatten(state)
+        weights = {
+            k: flat_state[f".params['{k}']"].astype(np.float32)
+            for k in state.params
+        }
+        primary = jax.process_index() == 0
+        if self.save_dir is None and primary:
             self._create_save_dir()
         v = self.save_version
-        weights = {k: np.asarray(x, dtype=np.float32) for k, x in state.params.items()}
-        np.savez(self.save_dir / f"{v}.npz", **weights)
-        cfg.to_json(self.save_dir / f"{v}_cfg.json")
-        np.savez(self.save_dir / f"{v}_train_state.npz", **self._flatten(state))
         meta = {
             "step": int(state.step),
             "save_version": v,
@@ -80,9 +106,15 @@ class Checkpointer:
         }
         if buffer is not None and hasattr(buffer, "state_dict"):
             meta["buffer"] = buffer.state_dict()
-        (self.save_dir / f"{v}_meta.json").write_text(json.dumps(meta, indent=2))
-        print(f"Saved as version {v} in {self.save_dir}")
+        if primary:
+            np.savez(self.save_dir / f"{v}.npz", **weights)
+            cfg.to_json(self.save_dir / f"{v}_cfg.json")
+            np.savez(self.save_dir / f"{v}_train_state.npz", **flat_state)
+            (self.save_dir / f"{v}_meta.json").write_text(json.dumps(meta, indent=2))
+            print(f"Saved as version {v} in {self.save_dir}")
         self.save_version += 1
+        if self.save_dir is None:
+            return Path(f"<process {jax.process_index()}: primary writes>")
         return self.save_dir / f"{v}.npz"
 
     # --- load/restore -------------------------------------------------------

@@ -316,8 +316,10 @@ class Trainer:
             self.logger.log(expand_metrics(metrics, self.cfg.n_sources), step)
 
     def save(self) -> None:
-        # restore runs on every process (SPMD), but only the primary writes
-        if self.checkpointer is not None and jax.process_index() == 0:
+        # ALL processes enter: the state fetch inside Checkpointer.save is
+        # a collective on a multi-host mesh (process_allgather of
+        # non-addressable leaves); only process 0 writes files
+        if self.checkpointer is not None:
             # quiesce the prefetch worker (no mid-next() device contention),
             # then checkpoint the PRE-prefetch stream snapshot so resume
             # replays the in-flight batch instead of skipping it
