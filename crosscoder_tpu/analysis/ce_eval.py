@@ -65,15 +65,29 @@ def crosscoder_reconstruct_fn(
     return Reconstructor(params=params, apply=cc.cached_apply(cfg, "forward"))
 
 
+# wrapper identity per callable: without this, every eval call would mint
+# a fresh lambda → fresh trace of _chunk_ces (apply is a static jit arg)
+# and the jit cache would retain each stale executable — the exact trap
+# the module docstring warns about, one layer up (ADVICE round-2)
+_WRAPPER_CACHE: dict[int, tuple[Any, Reconstructor]] = {}
+
+
 def _as_reconstructor(reconstruct) -> Reconstructor:
     if isinstance(reconstruct, Reconstructor):
         return reconstruct
     # bare callable: oracle tests and quick experiments. NB anything such a
-    # callable closes over IS baked into the compiled program as constants,
-    # and a fresh function object means a fresh trace — real crosscoders
-    # must come through crosscoder_reconstruct_fn (params as jit arguments,
-    # cached apply identity).
-    return Reconstructor(params=None, apply=lambda _, rows: reconstruct(rows))
+    # callable closes over IS baked into the compiled program as constants —
+    # real crosscoders must come through crosscoder_reconstruct_fn (params
+    # as jit arguments, cached apply identity).
+    cached = _WRAPPER_CACHE.get(id(reconstruct))
+    # the keyed object must still be alive (ids recycle): keep a strong ref
+    if cached is not None and cached[0] is reconstruct:
+        return cached[1]
+    rec = Reconstructor(params=None, apply=lambda _, rows: reconstruct(rows))
+    if len(_WRAPPER_CACHE) > 32:
+        _WRAPPER_CACHE.pop(next(iter(_WRAPPER_CACHE)))
+    _WRAPPER_CACHE[id(reconstruct)] = (reconstruct, rec)
+    return rec
 
 
 @functools.partial(jax.jit, static_argnames=("lm_cfg", "hook_point", "apply"))

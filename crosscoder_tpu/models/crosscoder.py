@@ -151,7 +151,10 @@ def cached_apply(cfg: CrossCoderConfig, kind: str = "forward"):
     fn = _APPLY_CACHE.get(key)
     if fn is None:
         if len(_APPLY_CACHE) > 32:
-            _APPLY_CACHE.clear()
+            # evict OLDEST only (dict preserves insertion order): clearing
+            # everything would orphan functions still live as static jit
+            # args and force a retrace of every active consumer
+            _APPLY_CACHE.pop(next(iter(_APPLY_CACHE)))
         if kind == "forward":
             def fn(p: Params, x: jax.Array) -> jax.Array:
                 return forward(p, x, cfg)

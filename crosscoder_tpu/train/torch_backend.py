@@ -115,17 +115,19 @@ class TorchTrainer:
         self.opt.step()
         self.sched.step()
         self.opt.zero_grad()
+        # detach before float(): converting a requires_grad tensor to a
+        # scalar warns on every step (ADVICE round-2)
         out = {
             "loss": float(loss.detach()),
-            "l2_loss": float(losses["l2_loss"]),
-            "l1_loss": float(losses["l1_loss"]),
-            "l0_loss": float(losses["l0_loss"]),
+            "l2_loss": float(losses["l2_loss"].detach()),
+            "l1_loss": float(losses["l1_loss"].detach()),
+            "l0_loss": float(losses["l0_loss"].detach()),
             "l1_coeff": float(l1c),
             "lr": lr_applied,
-            "explained_variance": float(losses["explained_variance"]),
+            "explained_variance": float(losses["explained_variance"].detach()),
         }
         for i, v in enumerate(losses["ev_per_source"]):
-            out[f"explained_variance_{source_tag(i)}"] = float(v)
+            out[f"explained_variance_{source_tag(i)}"] = float(v.detach())
         self.step_counter += 1
         return out
 
