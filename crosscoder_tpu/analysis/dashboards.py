@@ -220,9 +220,20 @@ class FeatureVisData:
 
     # -- rendering ----------------------------------------------------------
     def save_feature_centric_vis(
-        self, path: str | Path, decode_fn: Callable[[int], str] | None = None
+        self, path: str | Path, decode_fn: Callable[[int], str] | None = None,
+        tokenizer: str | Path | None = None,
     ) -> Path:
-        """Write one self-contained HTML file (nb:cell 42 equivalent)."""
+        """Write one self-contained HTML file (nb:cell 42 equivalent).
+
+        ``tokenizer`` — path to a local HF ``tokenizer.json`` (or a dir
+        holding one): token ids then render as real text, as in the
+        reference's sae_vis pages (nb:cells 36-42). Without either it and
+        ``decode_fn``, ids render as ``⟨id⟩`` placeholders.
+        """
+        if decode_fn is None and tokenizer is not None:
+            from crosscoder_tpu.analysis.plots import decode_fn_from_file
+
+            decode_fn = decode_fn_from_file(tokenizer)
         render = default_token_renderer(decode_fn)
         cards = []
         for fd in self.features:

@@ -123,3 +123,41 @@ def default_token_renderer(decode_fn: Callable[[int], str] | None):
     if decode_fn is None:
         return lambda tid: f"⟨{int(tid)}⟩"
     return lambda tid: decode_fn(int(tid))
+
+
+def decode_fn_from_file(path) -> Callable[[int], str]:
+    """Token-id → text from a LOCAL HF tokenizer file — no network.
+
+    ``path`` is a ``tokenizer.json`` (HF tokenizers format, the artifact
+    shipped inside every Gemma checkpoint dir) or a directory containing
+    one. Dashboards/replication render real text when this is wired in
+    (reference dashboards always had the tokenizer via TransformerLens,
+    nb:cells 36-42) and fall back to ⟨id⟩ placeholders otherwise.
+    """
+    import os
+    from pathlib import Path
+
+    # the Rust tokenizers' rayon worker pool can deadlock an in-flight XLA
+    # CPU collective rendezvous (observed: 7/8 device threads arriving);
+    # single-token decodes gain nothing from it anyway
+    os.environ.setdefault("TOKENIZERS_PARALLELISM", "false")
+    from tokenizers import Tokenizer
+
+    p = Path(path)
+    if p.is_dir():
+        p = p / "tokenizer.json"
+    tok = Tokenizer.from_file(str(p))
+
+    import functools
+
+    @functools.lru_cache(maxsize=65536)
+    def decode(tid: int) -> str:
+        # cached: dashboards render the same small set of distinct ids many
+        # times, and each decode is an FFI round trip into the Rust lib
+        text = tok.decode([int(tid)], skip_special_tokens=False)
+        if text:
+            return text
+        piece = tok.id_to_token(int(tid))
+        return piece if piece is not None else f"⟨{int(tid)}⟩"
+
+    return decode

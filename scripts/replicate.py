@@ -87,13 +87,15 @@ def ce_stage(tokens, lm_cfg, model_params, hook_point, folded_params, cfg, chunk
 
 
 def dashboards_stage(folded_params, cfg, lm_cfg, model_params, tokens,
-                     hook_point, features, out_dir: Path) -> dict:
+                     hook_point, features, out_dir: Path,
+                     tokenizer=None) -> dict:
     from crosscoder_tpu.analysis.dashboards import FeatureVisConfig, FeatureVisData
 
     vis_cfg = FeatureVisConfig(hook_point=hook_point, features=tuple(features))
     data = FeatureVisData.create(folded_params, cfg, lm_cfg, model_params,
                                  tokens, vis_cfg)
-    path = data.save_feature_centric_vis(out_dir / "dashboards.html")
+    path = data.save_feature_centric_vis(out_dir / "dashboards.html",
+                                         tokenizer=tokenizer)
     doc = path.read_text()
     return {
         "path": str(path),
@@ -209,7 +211,7 @@ def run(args) -> dict:
         print("[replicate] stage 4: dashboards ...")
         report["dashboards"] = dashboards_stage(
             folded, cfg, lm_cfg, model_params, eval_tokens, hook,
-            pick_features(params), out_dir)
+            pick_features(params), out_dir, tokenizer=args.tokenizer)
     else:
         report["ce"] = {}
         report["dashboards"] = {}
@@ -241,6 +243,9 @@ def main(argv=None):
     ap.add_argument("--n-seqs", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=4)
     ap.add_argument("--norm-factors", type=str, default=None)
+    ap.add_argument("--tokenizer", type=str, default=None,
+                    help="local HF tokenizer.json (or its dir): dashboards "
+                         "render real text instead of ⟨id⟩ placeholders")
     ap.add_argument("--demo-lm-steps", type=_positive_int, default=400)
     ap.add_argument("--demo-cc-steps", type=_positive_int, default=1500)
     ap.add_argument("--out", type=str, default="replicate_out")
