@@ -89,12 +89,20 @@ class Checkpointer:
         """
         # collective fetches first, identical order on all processes; each
         # leaf crosses the network ONCE — the weights artifact reuses the
-        # already-flattened state's param leaves
-        flat_state = self._flatten(state)
-        weights = {
-            k: flat_state[f".params['{k}']"].astype(np.float32)
-            for k in state.params
-        }
+        # same fetched arrays via an identity cache (no reliance on how
+        # keystr renders the params field, which is not a stable API)
+        fetched: dict[int, np.ndarray] = {}
+
+        def fetch(leaf):
+            out = fetched.get(id(leaf))
+            if out is None:
+                out = self._fetch_global(leaf)
+                fetched[id(leaf)] = out
+            return out
+
+        pathed = jax.tree_util.tree_flatten_with_path(state)[0]
+        flat_state = {jax.tree_util.keystr(p): fetch(leaf) for p, leaf in pathed}
+        weights = {k: fetch(x).astype(np.float32) for k, x in state.params.items()}
         primary = jax.process_index() == 0
         if self.save_dir is None and primary:
             self._create_save_dir()

@@ -371,12 +371,30 @@ class Trainer:
             print("[crosscoder_tpu] SIGTERM: stopping after this step, "
                   "writing checkpoint", flush=True)
 
+        multi_process = jax.process_count() > 1
+
+        def _stop_agreed() -> bool:
+            # Checkpointer.save is a COLLECTIVE on a multi-host mesh, so the
+            # decision to stop-and-save must be agreed by every process — a
+            # SIGTERM (preemption notice) often reaches only one host. A
+            # tiny allgathered flag makes the stop point SPMD-consistent;
+            # single-process runs skip the sync entirely.
+            if not multi_process:
+                return stop_requested
+            import numpy as _np
+
+            from jax.experimental import multihost_utils
+
+            flag = _np.array([1 if stop_requested else 0], _np.int32)
+            return bool(multihost_utils.process_allgather(flag).max())
+
         in_main_thread = threading.current_thread() is threading.main_thread()
         if in_main_thread:
             prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+        clean = False
         try:
             for i in progress:
-                if stop_requested:
+                if _stop_agreed():
                     break
                 if self.cfg.profile_dir and i == start + 10:
                     jax.profiler.start_trace(self.cfg.profile_dir)
@@ -397,12 +415,23 @@ class Trainer:
                     self.log(metrics, step=i)
                 if (i + 1) % self.cfg.save_every == 0:
                     self.save()
+            clean = True
         finally:
             if in_main_thread:
                 signal.signal(signal.SIGTERM, prev_handler or signal.SIG_DFL)
             if profiling:
                 jax.profiler.stop_trace()
-            self.save()
+            if clean or not multi_process:
+                # clean exits are SPMD-consistent (same step on every
+                # process), so the collective save is safe; a process-LOCAL
+                # exception on a multi-host mesh is not — entering a
+                # collective there would hang every healthy host, so skip
+                # the final save rather than deadlock the pod
+                self.save()
+            else:
+                print("[crosscoder_tpu] exception on a multi-process mesh: "
+                      "skipping the final (collective) checkpoint to avoid "
+                      "a cross-host deadlock", flush=True)
             self.close()
             if self.logger is not None:
                 self.logger.close()

@@ -62,6 +62,10 @@ assert int(tr2.state.step) == 3
 resumed = float(jax.device_get(tr2.step()["loss"]))
 assert np.isfinite(resumed)
 assert int(tr2.state.step) == 4
+# the full train() loop: multi-process stop sync + the collective final
+# save in `finally` must complete on BOTH processes (clean exit)
+tr2.train(num_steps=6)
+assert int(tr2.state.step) == 6
 tr2.close()
 
 print(json.dumps({"proc": proc_id, "losses": losses, "resumed_loss": resumed,
