@@ -270,6 +270,41 @@ def section_matrix() -> list[dict]:
     return out
 
 
+def section_configs() -> list[dict]:
+    """All five BASELINE.json scale-out configs at the train-step level —
+    each config's acts/s/chip on one chip (the 8× path is per-chip parity
+    × DP, so the per-chip number is the comparable unit):
+
+    1. 2-model L13, dict 2^14 (the reference's exact trained shape);
+    2. dict 2^15 + TopK(k=32) via the Pallas kernel;
+    3. Gemma-2-9B width (d_in 3584), dict 2^16;
+    4. 3-way diff (n_models=3);
+    5. multi-layer {6,13,20} jointly (n_sources = 2×3 = 6).
+    """
+    steps = int(os.environ.get("BENCH_CONFIG_STEPS", 12))
+    hp3 = ("blocks.6.hook_resid_pre", "blocks.13.hook_resid_pre",
+           "blocks.20.hook_resid_pre")
+    configs = [
+        ("1_ref_shape", dict(d_in=2304, dict_size=2**14)),
+        ("2_topk_pallas", dict(d_in=2304, dict_size=2**15, activation="topk",
+                               topk_k=32, l1_coeff=0.0)),
+        ("3_9b_width", dict(d_in=3584, dict_size=2**16)),
+        ("4_three_way", dict(d_in=2304, dict_size=2**14, n_models=3)),
+        ("5_multilayer", dict(d_in=2304, dict_size=2**14, hook_points=hp3)),
+    ]
+    out = []
+    for label, overrides in configs:
+        try:
+            r = bench_step(_make_cfg(**overrides), steps, warmup=2)
+            entry = {"config": label, **r}
+        except Exception as e:
+            entry = {"config": label,
+                     "error": f"{type(e).__name__}: {str(e)[:200]}"}
+        log(f"[configs] {entry}")
+        out.append(entry)
+    return out
+
+
 def section_e2e() -> dict:
     """harvest→buffer→train on one chip — the number the reference pipeline
     actually bounds (harvest ≈ 2.5× the train step's FLOPs per row)."""
@@ -450,9 +485,12 @@ def main() -> None:
                        "cold" if cache_dir else "disabled")
     except OSError:
         cache_state = "cold"
-    sections = os.environ.get("BENCH_SECTIONS", "step,matrix,e2e,dash").split(",")
+    sections = os.environ.get(
+        "BENCH_SECTIONS", "step,matrix,configs,e2e,dash"
+    ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
+                     ("configs", section_configs),
                      ("e2e", section_e2e), ("dash", section_dash)):
         if name not in sections:
             continue
