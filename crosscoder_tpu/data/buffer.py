@@ -379,7 +379,13 @@ class PairedActivationBuffer:
             while len(self._cyc_inflight) >= self.PIPELINE_DEPTH:
                 self._drain_one()
         # opportunistically land chunks the device already finished, so the
-        # trigger point finds (almost) nothing left to wait for
+        # trigger point finds (almost) nothing left to wait for. NOT on a
+        # multi-process mesh: is_ready() is host-local timing, and a drain
+        # dispatches a (collective) scatter — processes must make identical
+        # dispatch decisions or their rendezvous orders diverge. There the
+        # deterministic depth-bound/trigger drains do all the landing.
+        if jax.process_count() > 1:
+            return
         while len(self._cyc_inflight) > 1:
             try:
                 ready = self._cyc_inflight[0][0].is_ready()

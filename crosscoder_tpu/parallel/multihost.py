@@ -20,6 +20,19 @@ bare ``initialize()`` suffices there):
 Host-side work splits by :func:`is_primary` (checkpoint writes, metric
 logging, the buffer's token stream ownership); device-side work needs no
 gating — pjit/shard_map programs are SPMD across processes by construction.
+
+Proven with 2 REAL processes (``tests/test_multihost_ckpt.py``): the full
+data plane — sharded harvest → mesh-sharded HBM replay store → train step
+→ collective checkpoint → restore → continue — and the coordinated
+stop/save path. Two SPMD dispatch-order rules the framework enforces for
+multi-process runs (violations deadlock cross-host rendezvous):
+
+- the trainer's prefetch worker is disabled (its serve gather would race
+  the main thread's step differently per host) — ``Trainer.__init__``;
+- the buffer's opportunistic ``is_ready()`` drains are skipped (host-local
+  timing must not decide when a collective scatter is dispatched) —
+  ``_advance_cycle``; the depth-bound and trigger-point drains are
+  deterministic and do all the landing.
 """
 
 from __future__ import annotations

@@ -171,7 +171,16 @@ class Trainer:
         # dispatches intentionally stay concurrent with steps — but the
         # trainer's two per-step enqueues are cheap to serialize.
         self._dispatch_lock = threading.Lock()
-        if cfg.prefetch:
+        if cfg.prefetch and jax.process_count() > 1:
+            # multi-process SPMD requires every process to enqueue the same
+            # programs in the same order; a prefetch thread races its
+            # (collective) serve gather against the main thread's step
+            # differently on each host — a cross-process rendezvous
+            # mismatch. Serve synchronously instead.
+            print("[crosscoder_tpu] prefetch disabled on a multi-process "
+                  "mesh (nondeterministic cross-host dispatch order)",
+                  flush=True)
+        elif cfg.prefetch:
             self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="batch-prefetch"
             )

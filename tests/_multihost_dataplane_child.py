@@ -1,0 +1,86 @@
+"""Child process for the 2-process FULL-DATA-PLANE test.
+
+Run as: python _multihost_dataplane_child.py <proc_id> <port> <ckpt_dir>
+
+The whole production pipeline across 2 real processes (4 virtual CPU
+devices each, 8-way data mesh): tiny-LM pair harvest sharded over the
+process boundary → mesh-sharded HBM replay store (scatter/gather
+collectives) → jitted train step → collective checkpoint → restore →
+continue. This is the pod story end-to-end; the single-process 8-device
+tests can never catch a cross-process dispatch-order divergence.
+"""
+
+import json
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+workdir = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=proc_id
+)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from crosscoder_tpu.checkpoint.ckpt import Checkpointer  # noqa: E402
+from crosscoder_tpu.config import CrossCoderConfig  # noqa: E402
+from crosscoder_tpu.data.buffer import (  # noqa: E402
+    MeshPairedActivationBuffer, make_buffer,
+)
+from crosscoder_tpu.models import lm  # noqa: E402
+from crosscoder_tpu.parallel import mesh as mesh_lib  # noqa: E402
+from crosscoder_tpu.train.trainer import Trainer  # noqa: E402
+
+lm_cfg = lm.LMConfig.tiny()
+pair = [lm.init_params(jax.random.key(i), lm_cfg) for i in (0, 1)]
+tokens = np.random.default_rng(7).integers(0, 257, size=(64, 17), dtype=np.int64)
+
+cfg = CrossCoderConfig(
+    d_in=32, dict_size=64, n_models=2, batch_size=16, buffer_mult=32,
+    seq_len=17, model_batch_size=8, norm_calib_batches=1,
+    hook_point="blocks.1.hook_resid_pre", buffer_device="hbm",
+    data_axis_size=8, model_axis_size=1, num_tokens=10**9,
+    save_every=10**9, log_backend="null", checkpoint_dir=workdir,
+    # prefetch=True ON PURPOSE: Trainer must disable it on a multi-process
+    # mesh (the guard under test) — if the guard regresses, the prefetch
+    # thread's collective serve gathers race the steps differently on each
+    # host and this test deadlocks into its timeout
+    prefetch=True,
+)
+mesh = mesh_lib.mesh_from_cfg(cfg)
+sh = NamedSharding(mesh, P("data", None))
+
+
+def build():
+    buf = make_buffer(cfg, lm_cfg, pair, tokens, batch_sharding=sh)
+    assert isinstance(buf, MeshPairedActivationBuffer), type(buf)
+    return Trainer(cfg, buf, mesh=mesh, checkpointer=Checkpointer(workdir))
+
+
+tr = build()
+# 20 steps crosses the refill trigger (buffer 512 rows, trigger at 240),
+# so incremental refill scatters interleave with serve gathers
+losses = [float(jax.device_get(tr.step()["loss"])) for _ in range(20)]
+assert all(np.isfinite(l) for l in losses), losses
+tr.save()
+tr.close()
+
+tr2 = build()
+tr2.restore(version_dir=os.path.join(workdir, "version_0"))
+assert int(tr2.state.step) == 20
+resumed = [float(jax.device_get(tr2.step()["loss"])) for _ in range(3)]
+assert all(np.isfinite(l) for l in resumed), resumed
+tr2.close()
+
+print(json.dumps({"proc": proc_id, "losses": losses[-3:],
+                  "resumed": resumed, "ok": True}))

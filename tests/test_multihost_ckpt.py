@@ -60,3 +60,43 @@ def test_two_process_save_restore(tmp_path):
     vdir = tmp_path / "version_0"
     assert (vdir / "0.npz").exists()
     assert (vdir / "0_meta.json").exists()
+
+
+_DATAPLANE_CHILD = Path(__file__).with_name("_multihost_dataplane_child.py")
+
+
+@pytest.mark.slow
+def test_two_process_full_data_plane(tmp_path):
+    """harvest → mesh-sharded HBM store → train → checkpoint → restore →
+    continue, across 2 real processes: every collective (harvest psums,
+    store scatter/gather, grad reductions, checkpoint allgather) must be
+    dispatched in the same order on both hosts."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(_DATAPLANE_CHILD.parent.parent)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_DATAPLANE_CHILD), str(i), str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=str(_DATAPLANE_CHILD.parent.parent),
+        )
+        for i in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("dataplane child timed out (cross-process dispatch "
+                        "divergence deadlocks here)")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"child failed rc={rc}\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
+    results = [json.loads(out.strip().splitlines()[-1]) for _, out, _ in outs]
+    assert all(r["ok"] for r in results)
+    assert results[0]["losses"] == results[1]["losses"]
+    assert results[0]["resumed"] == results[1]["resumed"]
