@@ -492,6 +492,60 @@ def ce_loss(
 
 
 # ---------------------------------------------------------------------------
+# tensor-parallel harvest (models too big for one chip's HBM)
+
+
+def tp_shardings(mesh, axis: str = "model") -> LMParams:
+    """``NamedSharding`` pytree for TENSOR-PARALLEL LM params over
+    ``mesh[axis]`` — the Megatron layout expressed as annotations only;
+    GSPMD inserts the collectives (psum after ``wo``/``w_down``).
+
+    The reference fits its 2.6B pair on one GPU (train.py:45-55), so it
+    never needs this; BASELINE config 3 (Gemma-2-9B) does NOT fit one v5e
+    chip (both models' sub-hook layers ≈ 16.6 GB bf16), which makes the
+    harvest forward itself the thing to shard:
+
+    - ``wq``/``wk``/``wv``: head (output) axis sharded — each shard owns a
+      head group; the [B,S,heads,hd] reshape splits the sharded axis
+      cleanly when ``n_heads`` (and ideally ``n_kv_heads``) divide the
+      axis size.
+    - ``wo``/``w_down``: CONTRACTING axis sharded — partial products psum.
+    - ``w_gate``/``w_up``: hidden (output) axis sharded.
+    - ``embed``: d_model axis sharded — the token lookup stays shard-local.
+    - norms: replicated (tiny).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    return {
+        "embed": ns(None, axis),
+        "final_norm": ns(None),
+        "layers": {
+            "attn_norm": ns(None, None),
+            "post_attn_norm": ns(None, None),
+            "pre_ffw_norm": ns(None, None),
+            "post_ffw_norm": ns(None, None),
+            "wq": ns(None, None, axis),
+            "wk": ns(None, None, axis),
+            "wv": ns(None, None, axis),
+            "wo": ns(None, axis, None),
+            "w_gate": ns(None, None, axis),
+            "w_up": ns(None, None, axis),
+            "w_down": ns(None, axis, None),
+        },
+    }
+
+
+def shard_params_tp(params: LMParams, mesh, axis: str = "model") -> LMParams:
+    """Place (or re-place) LM params in the tensor-parallel layout. The
+    returned pytree feeds every forward/harvest entry point unchanged —
+    jit picks the layout up from the arrays and partitions accordingly."""
+    return jax.device_put(params, tp_shardings(mesh, axis))
+
+
+# ---------------------------------------------------------------------------
 # sequence-parallel forward (long-context harvest; SURVEY component N5)
 
 
@@ -681,11 +735,20 @@ def run_with_cache_multi_seq_parallel(
 # HF weight conversion (torch checkpoint → stacked JAX pytree)
 
 
-def from_torch_state_dict(sd: Mapping[str, Any], cfg: LMConfig, dtype: str | None = None) -> LMParams:
+def from_torch_state_dict(
+    sd: Mapping[str, Any], cfg: LMConfig, dtype: str | None = None,
+    shardings: LMParams | None = None,
+) -> LMParams:
     """Convert an HF-transformers Gemma2 ``state_dict`` to our stacked layout.
 
     Works on anything indexable with ``.numpy()``-able values (torch CPU
     tensors or numpy arrays). HF projections are [out, in]; ours are [in, out].
+
+    ``shardings`` (a :func:`tp_shardings`-shaped pytree of NamedShardings)
+    places each leaf DIRECTLY in its sharded layout as it is converted —
+    peak device memory is one shard per leaf, never the whole model, which
+    is what lets a pair bigger than one chip's HBM (BASELINE config 3) be
+    loaded at all. Without it, leaves go to the default device whole.
     """
     dt = dtype_of(dtype or cfg.dtype)
 
@@ -695,36 +758,54 @@ def from_torch_state_dict(sd: Mapping[str, Any], cfg: LMConfig, dtype: str | Non
             v = v.detach().to("cpu").float().numpy()
         return np.asarray(v, dtype=np.float32)
 
-    def stack(fmt: str, transpose: bool) -> jax.Array:
+    def leaf(path: tuple[str, ...], arr: np.ndarray) -> jax.Array:
+        arr = arr.astype(np.dtype(dt), copy=False)   # host-side cast (ml_dtypes)
+        if shardings is None:
+            return jnp.asarray(arr)
+        sh = shardings
+        for k in path:
+            sh = sh[k]
+        return jax.device_put(arr, sh)
+
+    def stack(key: str, fmt: str, transpose: bool) -> jax.Array:
         mats = [get(fmt.format(i)) for i in range(cfg.n_layers)]
         arr = np.stack([m.T if transpose else m for m in mats])
-        return jnp.asarray(arr, dtype=dt)
+        return leaf(("layers", key), arr)
 
     p = "model.layers.{}."
     return {
-        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype=dt),
-        "final_norm": jnp.asarray(get("model.norm.weight"), dtype=dt),
+        "embed": leaf(("embed",), get("model.embed_tokens.weight")),
+        "final_norm": leaf(("final_norm",), get("model.norm.weight")),
         "layers": {
-            "attn_norm": stack(p + "input_layernorm.weight", False),
-            "post_attn_norm": stack(p + "post_attention_layernorm.weight", False),
-            "pre_ffw_norm": stack(p + "pre_feedforward_layernorm.weight", False),
-            "post_ffw_norm": stack(p + "post_feedforward_layernorm.weight", False),
-            "wq": stack(p + "self_attn.q_proj.weight", True),
-            "wk": stack(p + "self_attn.k_proj.weight", True),
-            "wv": stack(p + "self_attn.v_proj.weight", True),
-            "wo": stack(p + "self_attn.o_proj.weight", True),
-            "w_gate": stack(p + "mlp.gate_proj.weight", True),
-            "w_up": stack(p + "mlp.up_proj.weight", True),
-            "w_down": stack(p + "mlp.down_proj.weight", True),
+            "attn_norm": stack("attn_norm", p + "input_layernorm.weight", False),
+            "post_attn_norm": stack("post_attn_norm", p + "post_attention_layernorm.weight", False),
+            "pre_ffw_norm": stack("pre_ffw_norm", p + "pre_feedforward_layernorm.weight", False),
+            "post_ffw_norm": stack("post_ffw_norm", p + "post_feedforward_layernorm.weight", False),
+            "wq": stack("wq", p + "self_attn.q_proj.weight", True),
+            "wk": stack("wk", p + "self_attn.k_proj.weight", True),
+            "wv": stack("wv", p + "self_attn.v_proj.weight", True),
+            "wo": stack("wo", p + "self_attn.o_proj.weight", True),
+            "w_gate": stack("w_gate", p + "mlp.gate_proj.weight", True),
+            "w_up": stack("w_up", p + "mlp.up_proj.weight", True),
+            "w_down": stack("w_down", p + "mlp.down_proj.weight", True),
         },
     }
 
 
-def from_hf(model_name_or_path: str, cfg: LMConfig | None = None) -> tuple[LMParams, LMConfig]:
+def from_hf(
+    model_name_or_path: str, cfg: LMConfig | None = None,
+    shardings: LMParams | None = None,
+) -> tuple[LMParams, LMConfig]:
     """Load Gemma-2 weights from a local HF checkpoint dir or the hub cache
     (the reference loads via TransformerLens ``from_pretrained_no_processing``,
     train.py:45-55). Gated behind an import so offline/test runs never touch
-    the hub."""
+    the hub.
+
+    Pass ``shardings=lm.tp_shardings(mesh)`` for models that do NOT fit one
+    chip (BASELINE config 3): each leaf is placed straight into its
+    tensor-parallel shards during conversion, so peak per-device memory is
+    the sharded footprint, never the whole model.
+    """
     import transformers  # deferred: heavyweight
 
     model = transformers.AutoModelForCausalLM.from_pretrained(
@@ -747,5 +828,5 @@ def from_hf(model_name_or_path: str, cfg: LMConfig | None = None) -> tuple[LMPar
             sliding_window=hf_cfg.sliding_window,
             query_pre_attn_scalar=float(hf_cfg.query_pre_attn_scalar),
         )
-    params = from_torch_state_dict(model.state_dict(), cfg)
+    params = from_torch_state_dict(model.state_dict(), cfg, shardings=shardings)
     return params, cfg
