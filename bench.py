@@ -316,6 +316,15 @@ def section_e2e() -> dict:
     from crosscoder_tpu.parallel import mesh as mesh_lib
     from crosscoder_tpu.train.trainer import Trainer
 
+    overrides = {}
+    e2e_act = os.environ.get("BENCH_E2E_ACTIVATION", "")
+    if e2e_act == "topk":              # BASELINE config 2's e2e number
+        overrides = dict(activation="topk", topk_k=32, l1_coeff=0.0)
+    elif e2e_act:
+        # other activations would need their own loss knobs — refuse
+        # rather than silently benching a mislabeled objective
+        raise ValueError(f"BENCH_E2E_ACTIVATION supports 'topk', got {e2e_act!r}")
+
     tiny = os.environ.get("BENCH_TINY") == "1"    # CI/debug only
     if tiny:
         hook_layer, full = 2, lm.LMConfig.tiny()
@@ -325,6 +334,7 @@ def section_e2e() -> dict:
             model_batch_size=4, norm_calib_batches=2, seq_len=17,
             hook_point="blocks.2.hook_resid_pre",
             num_tokens=10**12, save_every=10**9, prefetch=True,
+            **overrides,
         )
     else:
         hook_layer = 14
@@ -342,6 +352,7 @@ def section_e2e() -> dict:
             # 0.5 = reference-parity harvest:serve; lower trades data
             # freshness for harvest FLOPs (see cfg.refill_frac)
             refill_frac=float(os.environ.get("BENCH_REFILL_FRAC", 0.5)),
+            **overrides,
         )
     n_dev = len(jax.devices())
     mesh = mesh_lib.make_mesh(data_axis_size=n_dev, model_axis_size=1)

@@ -1,0 +1,53 @@
+"""Child for the 2-process one-host-SIGTERM test.
+
+Run as: python _multihost_sigterm_child.py <proc_id> <port> <ckpt_dir>
+
+The parent SIGTERMs ONLY process 0 mid-train. The coordinated stop
+(`_stop_agreed` allgather in Trainer.train) must bring BOTH processes to
+the same step boundary, run the collective checkpoint on both, and exit
+cleanly — the exact scenario that deadlocked before round-3's fix (one
+host inside process_allgather, the other still launching train steps).
+"""
+
+import json
+import os
+import sys
+
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+workdir = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=proc_id
+)
+
+import numpy as np  # noqa: E402
+
+from crosscoder_tpu.checkpoint.ckpt import Checkpointer  # noqa: E402
+from crosscoder_tpu.config import CrossCoderConfig  # noqa: E402
+from crosscoder_tpu.parallel import mesh as mesh_lib  # noqa: E402
+from crosscoder_tpu.train.trainer import Trainer  # noqa: E402
+
+cfg = CrossCoderConfig(
+    d_in=32, dict_size=64, n_models=2, batch_size=16,
+    num_tokens=16 * 100_000, enc_dtype="fp32",
+    data_axis_size=2, model_axis_size=4,
+    log_backend="null", checkpoint_dir=workdir, prefetch=False,
+    save_every=10**9, log_every=10**9,
+)
+mesh = mesh_lib.mesh_from_cfg(cfg)
+tr = Trainer(cfg, mesh=mesh, checkpointer=Checkpointer(workdir))
+
+print(json.dumps({"proc": proc_id, "ready": True}), flush=True)
+# 100k steps ≈ forever on CPU: only the signal can end this loop
+tr.train()
+final_step = int(tr.state.step)
+assert np.isfinite(float(jax.device_get(tr.state.params["W_enc"]).sum()))
+print(json.dumps({"proc": proc_id, "stopped_at": final_step, "ok": True}),
+      flush=True)

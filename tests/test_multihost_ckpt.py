@@ -100,3 +100,83 @@ def test_two_process_full_data_plane(tmp_path):
     assert all(r["ok"] for r in results)
     assert results[0]["losses"] == results[1]["losses"]
     assert results[0]["resumed"] == results[1]["resumed"]
+
+
+_SIGTERM_CHILD = Path(__file__).with_name("_multihost_sigterm_child.py")
+
+
+@pytest.mark.slow
+def test_one_host_sigterm_checkpoints_both_processes(tmp_path):
+    """SIGTERM delivered to ONE host of a 2-process mesh: the stop-flag
+    allgather must bring both processes to the same boundary, both must
+    run the collective checkpoint, and both must exit 0 — previously one
+    host entered the collective save while the other kept training."""
+    import signal
+    import time
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(_SIGTERM_CHILD.parent.parent)
+    # stderr to files: a chatty child must not block on a full pipe during
+    # the long ready-wait phase (stdout stays a pipe — it only carries the
+    # two tiny JSON lines)
+    err_files = [open(tmp_path / f"child{i}.err", "w+") for i in (0, 1)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_SIGTERM_CHILD), str(i), str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=err_files[i], text=True,
+            cwd=str(_SIGTERM_CHILD.parent.parent),
+        )
+        for i in (0, 1)
+    ]
+    # wait for both children to reach the train loop (the "ready" line),
+    # then let a few steps run and SIGTERM process 0 only
+    deadline = time.monotonic() + 300
+    import select
+
+    ready = [False, False]
+    exited = [False, False]
+    bufs = ["", ""]
+    while not all(ready) and time.monotonic() < deadline:
+        live = [p.stdout for i, p in enumerate(procs) if not (ready[i] or exited[i])]
+        if not live:
+            break
+        rl, _, _ = select.select(live, [], [], 5)
+        for f in rl:
+            i = 0 if f is procs[0].stdout else 1
+            line = f.readline()
+            if line == "":             # EOF: child exited before ready
+                exited[i] = True
+                continue
+            bufs[i] += line
+            if '"ready": true' in line:
+                ready[i] = True
+    assert all(ready), (f"children never became ready (exited={exited}): "
+                        f"{bufs} / stderr tails: "
+                        f"{[open(tmp_path / f'child{i}.err').read()[-800:] for i in (0, 1)]}")
+    time.sleep(5)                      # a few steps
+    procs[0].send_signal(signal.SIGTERM)
+
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("child hung after one-host SIGTERM (stop not "
+                        "coordinated / collective save mismatch)")
+        err_files[i].seek(0)
+        outs.append((p.returncode, out, err_files[i].read()))
+        err_files[i].close()
+    results = []
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"child {i} rc={rc}\nstdout:\n{bufs[i] + out}\nstderr:\n{err[-3000:]}"
+        results.append(json.loads((bufs[i] + out).strip().splitlines()[-1]))
+    assert all(r["ok"] for r in results)
+    # both processes stopped at the SAME step (the allgathered flag)
+    assert results[0]["stopped_at"] == results[1]["stopped_at"] > 0
+    # and the collective final save landed on disk (written by process 0)
+    assert (tmp_path / "version_0" / "0.npz").exists()

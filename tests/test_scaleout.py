@@ -207,3 +207,42 @@ def test_from_torch_state_dict_places_into_tp_shards():
             a, b = a[k], b[k]
         np.testing.assert_array_equal(np.asarray(a, np.float32),
                                       np.asarray(b, np.float32))
+
+
+def test_analysis_paths_take_tp_params():
+    """CE-recovered eval and dashboards run with TENSOR-PARALLEL subject
+    params unchanged (the 9B analysis story), matching replicated-params
+    results to fp32 tolerance."""
+    from jax.sharding import Mesh
+
+    from crosscoder_tpu.analysis.ce_eval import get_ce_recovered_metrics
+    from crosscoder_tpu.analysis.dashboards import FeatureVisConfig, FeatureVisData
+    from crosscoder_tpu.models import crosscoder as cc
+
+    lm_cfg = lm.LMConfig.tiny()
+    pair = [lm.init_params(jax.random.key(i), lm_cfg) for i in (0, 1)]
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+    tp_pair = [lm.shard_params_tp(p, mesh) for p in pair]
+    rng = np.random.default_rng(11)
+    toks = rng.integers(0, 257, size=(8, 24), dtype=np.int64)
+    ccfg = CrossCoderConfig(d_in=lm_cfg.d_model, dict_size=64, batch_size=16,
+                            enc_dtype="fp32",
+                            hook_point="blocks.2.hook_resid_pre")
+    cc_params = cc.init_params(jax.random.key(3), ccfg)
+
+    from crosscoder_tpu.analysis.ce_eval import crosscoder_reconstruct_fn
+
+    rec = crosscoder_reconstruct_fn(cc_params, ccfg)
+    dense = get_ce_recovered_metrics(toks, lm_cfg, pair,
+                                     "blocks.2.hook_resid_pre", rec, chunk=4)
+    tp = get_ce_recovered_metrics(toks, lm_cfg, tp_pair,
+                                  "blocks.2.hook_resid_pre", rec, chunk=4)
+    for k in dense:
+        np.testing.assert_allclose(tp[k], dense[k], rtol=1e-3, atol=1e-4)
+
+    vis_cfg = FeatureVisConfig(hook_point="blocks.2.hook_resid_pre",
+                               features=(3, 5), minibatch_size_tokens=4)
+    d1 = FeatureVisData.create(cc_params, ccfg, lm_cfg, pair, toks, vis_cfg)
+    d2 = FeatureVisData.create(cc_params, ccfg, lm_cfg, tp_pair, toks, vis_cfg)
+    for f1, f2 in zip(d1.features, d2.features):
+        np.testing.assert_allclose(f2.max_act, f1.max_act, rtol=1e-3, atol=1e-5)
