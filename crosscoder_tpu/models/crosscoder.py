@@ -171,14 +171,15 @@ def cached_apply(cfg: CrossCoderConfig, kind: str = "forward"):
 # which at TopK(k=32) multiplies ~0.1% nonzeros).
 #
 # Measured guidance (TPU v5e, k 32, batch 4096, full train step —
-# artifacts/BENCH_r02_local.json matrix): at dict 2^15 the DENSE decode
-# wins (78.16 vs 99.66 ms/step) because at B·k/H ≈ 4 hits per latent every
+# artifacts/BENCH_r03_local.json matrix): at dict 2^15 the DENSE decode
+# wins (76.7 vs 95.0 ms/step) because at B·k/H ≈ 4 hits per latent every
 # W_dec row is read anyway, the dense matmul is a compute-bound MXU op,
-# and XLA's row gather runs well below HBM bandwidth. The crossover lands
-# at dict 2^17 where the dense matmul's FLOPs dominate and this path wins
-# (255.93 vs 283.21 ms/step); at 2^16 they are within noise (160.62 vs
-# 156.77, dense slightly ahead). Default stays cfg.sparse_decode=False;
-# flip it at 2^17+.
+# and XLA's row gather runs well below HBM bandwidth. Against the plain
+# dense path this gather wins at 2^17 (251.0 vs 278.3 ms/step) — but
+# round-3's width-chunked Pallas TopK moved the goalposts: the
+# kernel+dense-decode step is faster still at every dict (208.3 ms at
+# 2^17), so cfg.sparse_decode now only pays on shapes the kernel's
+# supported() gate rejects. Default stays False.
 
 
 @jax.custom_vjp
