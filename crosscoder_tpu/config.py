@@ -98,6 +98,11 @@ class CrossCoderConfig:
                                     # (buffer.py:18-22); on a multi-chip
                                     # mesh the store shards over the data
                                     # axis and serves batches pre-sharded
+    shard_lm: bool = False          # tensor-parallel harvest: load/keep the
+                                    # subject LMs' weights sharded over the
+                                    # 'model' mesh axis (lm.tp_shardings) —
+                                    # for pairs too big for one chip's HBM
+                                    # (e.g. Gemma-2-9B, BASELINE config 3)
     seq_shards: int = 0             # >0: harvest forwards shard the SEQUENCE
                                     # axis over the mesh data axis (ring
                                     # attention), for contexts too long for
@@ -169,6 +174,18 @@ class CrossCoderConfig:
             )
         if self.seq_shards < 0:
             raise ValueError("seq_shards must be >= 0")
+        if self.shard_lm and self.model_axis_size < 2:
+            raise ValueError(
+                "shard_lm needs model_axis_size >= 2 (a 1-wide model axis "
+                "shards nothing)"
+            )
+        if self.shard_lm and self.seq_shards > 1:
+            raise ValueError(
+                "shard_lm is incompatible with seq_shards: the seq-parallel "
+                "harvest replicates LM params (its shard_map in_specs), "
+                "which would silently all-gather the TP shards onto every "
+                "device — the OOM shard_lm exists to prevent"
+            )
         if self.seq_shards > 1 and self.seq_len % self.seq_shards != 0:
             raise ValueError(
                 f"seq_shards {self.seq_shards} must divide seq_len {self.seq_len}"

@@ -80,6 +80,11 @@ class PairedActivationBuffer:
     # reference's behavior); see crosscoder_tpu.utils.pipeline
     PIPELINE_DEPTH = pipeline.DEFAULT_DEPTH
 
+    # the host store funnels every harvest chunk through one process's RAM
+    # (device_get raises on cross-process-sharded arrays); the device/mesh
+    # subclasses keep rows on device and override this
+    _MULTIPROCESS_OK = False
+
     def _pipelined(self, produced, drain) -> None:
         pipeline.drive(produced, drain, depth=self.PIPELINE_DEPTH)
 
@@ -94,6 +99,14 @@ class PairedActivationBuffer:
     ) -> None:
         if len(model_params) != cfg.n_models:
             raise ValueError(f"got {len(model_params)} param sets for n_models={cfg.n_models}")
+        if not self._MULTIPROCESS_OK and jax.process_count() > 1:
+            # fail at CONSTRUCTION, before model loads / calibration burn
+            # minutes of device time, not at the first harvest drain
+            raise ValueError(
+                "buffer_device='host' cannot run on a multi-process mesh "
+                "(chunks funnel through one process's RAM); use "
+                "buffer_device='hbm' — the mesh-sharded store"
+            )
         self.cfg = cfg
         self.lm_cfg = lm_cfg
         self.model_params = list(model_params)
@@ -580,6 +593,9 @@ def _dev_scatter(store: jax.Array, positions: jax.Array, acts: jax.Array) -> jax
 class DevicePairedActivationBuffer(PairedActivationBuffer):
     """The replay store in device HBM instead of host RAM.
 
+    Rows never funnel through host RAM, so multi-process meshes are fine
+    (make_buffer picks the mesh-sharded subclass there; _MULTIPROCESS_OK).
+
     Same serve/refill semantics, cycle accounting, and resume state as the
     host-RAM parent (all that logic is inherited; only the storage ops
     differ): harvested activations are scattered into an HBM-resident
@@ -604,6 +620,8 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
       ``make_buffer`` picks :class:`MeshPairedActivationBuffer`, which
       shards this store over the ``data`` axis.
     """
+
+    _MULTIPROCESS_OK = True
 
     def _alloc_store(self) -> None:
         cfg = self.cfg

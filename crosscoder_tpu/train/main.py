@@ -46,7 +46,17 @@ def build_buffer(cfg: CrossCoderConfig, mesh) -> tuple[Any, CrossCoderConfig]:
     if len(names) != cfg.n_models:
         raise ValueError(f"{len(names)} model names for n_models={cfg.n_models}")
     lm_cfg = lm.config_for(names[0])
-    params_list = [lm.from_hf(n, lm_cfg)[0] for n in names]
+    lm_shardings = None
+    if cfg.shard_lm:
+        if int(mesh.shape.get("model", 1)) < 2:
+            raise ValueError(
+                "--shard-lm true needs a model mesh axis >= 2 "
+                "(--model-axis-size); a 1-wide axis shards nothing"
+            )
+        # leaves go straight into their tensor-parallel shards during
+        # conversion — the full model never lands on one device
+        lm_shardings = lm.tp_shardings(mesh)
+    params_list = [lm.from_hf(n, lm_cfg, shardings=lm_shardings)[0] for n in names]
     cfg = cfg.replace(d_in=lm_cfg.d_model)
     tokens = load_pile_lmsys_mixed_tokens(cfg)
     buffer = make_buffer(

@@ -3,6 +3,7 @@
 
 import json
 
+import jax
 import numpy as np
 import pytest
 
@@ -79,3 +80,48 @@ def test_tokens_loader_accepts_reference_pt_cache(tmp_path):
     got = load_pile_lmsys_mixed_tokens(cfg)
     assert got.dtype == np.int32
     np.testing.assert_array_equal(got, want)
+
+
+def test_build_buffer_shard_lm_plumbing(monkeypatch):
+    """--shard-lm true loads LM weights through lm.from_hf with the
+    tensor-parallel shardings (and refuses a 1-wide model axis)."""
+    from crosscoder_tpu.models import lm
+    from crosscoder_tpu.train import main as main_mod
+
+    lm_cfg = lm.LMConfig.tiny()
+    seen = {}
+
+    def fake_from_hf(name, cfg=None, shardings=None):
+        seen[name] = shardings
+        return lm.init_params(jax.random.key(0), lm_cfg), lm_cfg
+
+    def fake_tokens(cfg, mmap=True):
+        return np.random.default_rng(0).integers(
+            0, 257, size=(64, cfg.seq_len), dtype=np.int64)
+
+    monkeypatch.setattr(lm, "from_hf", fake_from_hf)
+    monkeypatch.setattr(lm, "config_for", lambda name: lm_cfg)
+    import crosscoder_tpu.data.tokens as tokens_mod
+    monkeypatch.setattr(tokens_mod, "load_pile_lmsys_mixed_tokens", fake_tokens)
+
+    cfg = CrossCoderConfig(
+        data_source="gemma", shard_lm=True, model_names=("gemma-2-2b", "gemma-2-2b-it"),
+        batch_size=16, buffer_mult=32, seq_len=17, model_batch_size=8,
+        norm_calib_batches=1, hook_point="blocks.1.hook_resid_pre",
+        data_axis_size=4, model_axis_size=2, log_backend="null",
+        prefetch=False,
+    )
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    buf, cfg2 = main_mod.build_buffer(cfg, mesh)
+    assert cfg2.d_in == lm_cfg.d_model
+    assert set(seen) == {"gemma-2-2b", "gemma-2-2b-it"}
+    for sh in seen.values():
+        assert sh is not None and sh["layers"]["wq"].spec[2] == "model"
+
+    # 1-wide model axis refused at CONFIG time
+    with pytest.raises(ValueError, match="shard_lm"):
+        cfg.replace(data_axis_size=8, model_axis_size=1)
+    # and the seq-parallel harvest (replicated-params shard_map) refused too
+    with pytest.raises(ValueError, match="seq_shards"):
+        cfg.replace(seq_shards=4, seq_len=16)
