@@ -84,6 +84,17 @@ class CrossCoderConfig:
                                     # dense [B,H]x[H,n,d] matmul
     jumprelu_theta: float = 0.001   # initial JumpReLU threshold
     jumprelu_bandwidth: float = 0.001  # STE bandwidth for the threshold gradient
+    l0_coeff: float = 0.0           # jumprelu only: coefficient on the
+                                    # rectangle-kernel-STE L0 penalty (the
+                                    # JumpReLU paper's sparsity objective);
+                                    # combine with l1_coeff=0 for pure-L0
+                                    # training
+    batchtopk_threshold: float = 0.0   # >0: batchtopk EVAL mode — a fixed
+                                    # global threshold (from
+                                    # crosscoder.calibrate_batchtopk_threshold)
+                                    # so per-example activations don't
+                                    # depend on batch composition; 0 =
+                                    # per-batch k·B-th threshold (training)
     data_axis_size: int = -1        # -1: all remaining devices on the data axis
     model_axis_size: int = 1        # tensor-parallel shards of the dict axis
     shard_sources: bool = False     # EP-style: shard the SOURCE axis
@@ -193,6 +204,16 @@ class CrossCoderConfig:
         if self.sparse_decode and self.activation != "topk":
             raise ValueError(
                 f"sparse_decode requires activation='topk', got {self.activation!r}"
+            )
+        if self.l0_coeff > 0 and self.activation != "jumprelu":
+            raise ValueError(
+                f"l0_coeff requires activation='jumprelu' (the rectangle-"
+                f"kernel STE needs a threshold), got {self.activation!r}"
+            )
+        if self.batchtopk_threshold > 0 and self.activation != "batchtopk":
+            raise ValueError(
+                f"batchtopk_threshold requires activation='batchtopk', "
+                f"got {self.activation!r}"
             )
 
     # --- derived quantities -------------------------------------------------

@@ -56,6 +56,9 @@ class LossOutput(NamedTuple):
     l0_loss: jax.Array                    # scalar: mean active latents
     explained_variance: jax.Array         # [batch]
     explained_variance_per_source: jax.Array  # [n_sources, batch] (ref: _A/_B pair)
+    # jumprelu + cfg.l0_coeff only: the rectangle-kernel-STE L0 penalty
+    # term (differentiable in θ; equals l0_loss in value). 0.0 elsewhere.
+    l0_penalty: jax.Array | float = 0.0
 
 
 def init_params(key: jax.Array, cfg: CrossCoderConfig, dtype: jnp.dtype | None = None) -> Params:
@@ -112,6 +115,31 @@ def encode(params: Params, x: jax.Array, cfg: CrossCoderConfig, *, apply_activat
     if not apply_activation:
         return h
     return act_ops.apply(h, cfg, params)
+
+
+def calibrate_batchtopk_threshold(
+    params: Params, cfg: CrossCoderConfig, batches
+) -> float:
+    """Mean per-batch BatchTopK threshold over representative batches —
+    the fixed global threshold for EVAL (set it as
+    ``cfg.batchtopk_threshold``; dispatch then uses
+    :func:`crosscoder_tpu.ops.activations.batchtopk_fixed` so one
+    example's activations never depend on the rest of its batch).
+
+    ``batches``: iterable of ``[B, n_sources, d_in]`` activation batches
+    (normalized exactly as training batches were).
+    """
+    import numpy as np
+
+    @jax.jit
+    def one(x):
+        hp = jax.nn.relu(pre_acts(params, x.astype(dtype_of(cfg.enc_dtype))))
+        return act_ops.batchtopk_threshold_of(hp, cfg.topk_k)
+
+    vals = [float(jax.device_get(one(jnp.asarray(b)))) for b in batches]
+    if not vals:
+        raise ValueError("calibrate_batchtopk_threshold needs >= 1 batch")
+    return float(np.mean(vals))
 
 
 def decode(params: Params, f: jax.Array) -> jax.Array:
@@ -269,6 +297,7 @@ def get_losses(
     """
     x = x.astype(dtype_of(cfg.enc_dtype))
     sparse = cfg.sparse_decode and cfg.activation == "topk"
+    l0_penalty: jax.Array | float = 0.0
     if sparse:
         # factored TopK path: decode touches only the k active rows; the
         # rounding of recon through the compute dtype matches the dense
@@ -276,6 +305,16 @@ def get_losses(
         recon_f32, vals, idx = sparse_topk_forward(params, x, cfg)
         recon = recon_f32.astype(x.dtype)
         f = None
+    elif cfg.activation == "jumprelu" and cfg.l0_coeff > 0:
+        # share the encode pre-acts with the L0 penalty (the JumpReLU
+        # paper's sparsity objective needs h near θ, which the
+        # post-activation f has zeroed)
+        h = pre_acts(params, x)
+        f = act_ops.apply(h, cfg, params)
+        recon = decode(params, f)
+        l0_penalty = act_ops.jumprelu_l0(
+            h, params["log_theta"], cfg.jumprelu_bandwidth
+        )
     else:
         f = encode(params, x, cfg)
         recon = decode(params, f)
@@ -306,6 +345,7 @@ def get_losses(
             explained_variance_per_source=jnp.zeros(
                 (x.shape[-2], x.shape[0]), jnp.float32
             ),
+            l0_penalty=l0_penalty,
         )
 
     eps = 1e-8
@@ -330,6 +370,7 @@ def get_losses(
         l0_loss=l0_loss,
         explained_variance=explained_variance,
         explained_variance_per_source=jnp.transpose(ev_per_source),
+        l0_penalty=l0_penalty,
     )
 
 
@@ -359,7 +400,10 @@ def training_loss(
     )
     # TopK-style runs control sparsity structurally and typically set
     # l1_coeff=0 in config; the objective shape is the same either way.
+    # JumpReLU runs may add the paper's L0 objective via cfg.l0_coeff.
     loss = losses.l2_loss + l1_coeff * losses.l1_loss
+    if cfg.l0_coeff > 0:
+        loss = loss + cfg.l0_coeff * losses.l0_penalty
     return loss, losses
 
 

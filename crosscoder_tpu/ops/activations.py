@@ -81,13 +81,21 @@ def batchtopk(h: jax.Array, k: int) -> jax.Array:
     reduction that fuses and scales to any size.
     """
     hp = relu(h)
+    thresh = batchtopk_threshold_of(hp, k)
+    mask = (hp >= thresh) & (hp > 0)
+    return hp * jax.lax.stop_gradient(mask.astype(hp.dtype))
+
+
+def batchtopk_threshold_of(hp: jax.Array, k: int) -> jax.Array:
+    """The (k·batch)-th largest of the ReLU'd pre-acts — THE BatchTopK
+    threshold definition, shared by training dispatch and by eval
+    calibration (:func:`crosscoder_tpu.models.crosscoder.
+    calibrate_batchtopk_threshold`) so the two can never diverge."""
     n_rows = 1
     for s in hp.shape[:-1]:
         n_rows *= s
     kk = min(k * n_rows, hp.size)
-    thresh = _kth_largest_nonneg(hp, kk)
-    mask = (hp >= thresh) & (hp > 0)
-    return hp * jax.lax.stop_gradient(mask.astype(hp.dtype))
+    return _kth_largest_nonneg(hp, kk)
 
 
 # thresholds evaluated per bisection pass (each pass = ONE fused read of
@@ -173,6 +181,50 @@ def _jumprelu_bwd(bandwidth, res, g):
 jumprelu.defvjp(_jumprelu_fwd, _jumprelu_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def jumprelu_l0(h: jax.Array, log_theta: jax.Array, bandwidth: float) -> jax.Array:
+    """Differentiable-in-θ L0: ``mean_b Σ_f 1[h > θ_f]`` (the JumpReLU
+    paper's sparsity objective — Rajamanoharan et al. 2024 eq. 10). The
+    step function's θ-gradient uses the same rectangle-kernel STE as the
+    activation: ``∂/∂θ ≈ −(1/ε)·K((h−θ)/ε)`` per element, averaged over
+    the batch; ``h`` gets no gradient (the paper's pseudo-derivative)."""
+    theta = jnp.exp(log_theta).astype(h.dtype)
+    return jnp.mean(jnp.sum((h > theta).astype(jnp.float32), axis=-1))
+
+
+def _jumprelu_l0_fwd(h, log_theta, bandwidth):
+    theta = jnp.exp(log_theta).astype(h.dtype)
+    val = jnp.mean(jnp.sum((h > theta).astype(jnp.float32), axis=-1))
+    return val, (h, theta)
+
+
+def _jumprelu_l0_bwd(bandwidth, res, g):
+    h, theta = res
+    hf = h.astype(jnp.float32)
+    tf = theta.astype(jnp.float32)
+    rect = (jnp.abs(hf - tf) <= bandwidth / 2).astype(jnp.float32)
+    # d/dθ_f of mean_b Σ_f H(h−θ_f) ≈ −(1/ε)·mean_b rect[b,f];
+    # chain through θ = exp(log_theta)
+    batch_axes = tuple(range(rect.ndim - 1))
+    dtheta = -(1.0 / bandwidth) * jnp.mean(rect, axis=batch_axes)
+    dlog_theta = (g * dtheta * tf).astype(jnp.float32)
+    return jnp.zeros_like(h), dlog_theta
+
+
+jumprelu_l0.defvjp(_jumprelu_l0_fwd, _jumprelu_l0_bwd)
+
+
+def batchtopk_fixed(h: jax.Array, threshold: float) -> jax.Array:
+    """BatchTopK EVAL mode: a calibrated fixed global threshold, so one
+    example's activations never depend on what else is in the batch
+    (Bussmann et al. 2024 use the mean training threshold at inference).
+    Calibrate with :func:`crosscoder_tpu.models.crosscoder.
+    calibrate_batchtopk_threshold`."""
+    hp = relu(h)
+    mask = (hp >= jnp.asarray(threshold, hp.dtype)) & (hp > 0)
+    return hp * jax.lax.stop_gradient(mask.astype(hp.dtype))
+
+
 def apply(h: jax.Array, cfg: "CrossCoderConfig", params: dict | None = None) -> jax.Array:
     """Dispatch on ``cfg.activation``."""
     if cfg.activation == "relu":
@@ -180,6 +232,8 @@ def apply(h: jax.Array, cfg: "CrossCoderConfig", params: dict | None = None) -> 
     if cfg.activation == "topk":
         return topk(h, cfg.topk_k)
     if cfg.activation == "batchtopk":
+        if cfg.batchtopk_threshold > 0:
+            return batchtopk_fixed(h, cfg.batchtopk_threshold)
         return batchtopk(h, cfg.topk_k)
     if cfg.activation == "jumprelu":
         if params is None or "log_theta" not in params:
