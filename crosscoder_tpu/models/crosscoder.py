@@ -133,7 +133,10 @@ def calibrate_batchtopk_threshold(
 
     @jax.jit
     def one(x):
-        hp = jax.nn.relu(pre_acts(params, x.astype(dtype_of(cfg.enc_dtype))))
+        # cast like training does (fp32 masters -> enc_dtype): the order
+        # statistic must come from the same bf16 pre-acts training saw
+        cp = cast_params(params, dtype_of(cfg.enc_dtype))
+        hp = jax.nn.relu(pre_acts(cp, x.astype(dtype_of(cfg.enc_dtype))))
         return act_ops.batchtopk_threshold_of(hp, cfg.topk_k)
 
     vals = [float(jax.device_get(one(jnp.asarray(b)))) for b in batches]
@@ -388,6 +391,7 @@ def training_loss(
     l1_coeff: jax.Array | float,
     cfg: CrossCoderConfig,
     with_metrics: bool = True,
+    l0_coeff: jax.Array | float | None = None,
 ) -> tuple[jax.Array, LossOutput]:
     """Scalar training objective ``l2 + l1_coeff · l1`` (reference
     ``trainer.py:44``) plus the full loss surface as aux.
@@ -400,10 +404,12 @@ def training_loss(
     )
     # TopK-style runs control sparsity structurally and typically set
     # l1_coeff=0 in config; the objective shape is the same either way.
-    # JumpReLU runs may add the paper's L0 objective via cfg.l0_coeff.
+    # JumpReLU runs may add the paper's L0 objective via cfg.l0_coeff
+    # (``l0_coeff`` overrides it — the trainer passes the warmed-up value).
     loss = losses.l2_loss + l1_coeff * losses.l1_loss
     if cfg.l0_coeff > 0:
-        loss = loss + cfg.l0_coeff * losses.l0_penalty
+        eff = cfg.l0_coeff if l0_coeff is None else l0_coeff
+        loss = loss + eff * losses.l0_penalty
     return loss, losses
 
 

@@ -91,6 +91,16 @@ class LMConfig:
         )
 
     @classmethod
+    def gemma2_27b(cls) -> "LMConfig":
+        """Gemma-2-27B — the family's largest member (NB: unlike 2B/9B its
+        query scale is d_model/n_heads = 144, not head_dim)."""
+        return cls(
+            vocab_size=256_000, d_model=4608, n_layers=46, n_heads=32,
+            n_kv_heads=16, head_dim=128, d_ff=36_864,
+            query_pre_attn_scalar=144.0,
+        )
+
+    @classmethod
     def tiny(cls, vocab_size: int = 257, n_layers: int = 4) -> "LMConfig":
         """Deterministic test-sized config (the 'fake LM' of SURVEY.md §4 —
         same hook semantics as the real model, no 2.6B-param download)."""
@@ -109,6 +119,8 @@ _NAMED_CONFIGS = {
     "gemma-2-2b-it": LMConfig.gemma2_2b,
     "gemma-2-9b": LMConfig.gemma2_9b,
     "gemma-2-9b-it": LMConfig.gemma2_9b,
+    "gemma-2-27b": LMConfig.gemma2_27b,
+    "gemma-2-27b-it": LMConfig.gemma2_27b,
 }
 
 

@@ -41,15 +41,29 @@ def lr_schedule(cfg: CrossCoderConfig) -> Schedule:
     return f
 
 
-def l1_coeff_schedule(cfg: CrossCoderConfig) -> Schedule:
+def sparsity_warmup_schedule(cfg: CrossCoderConfig) -> Schedule:
+    """The bare 0→1 ramp of the reference's L1 warmup (same
+    ``l1_warmup_frac`` window) — the single definition of the ramp;
+    :func:`l1_coeff_schedule` is ``cfg.l1_coeff ×`` this, and the trainer
+    scales ``cfg.l0_coeff`` by it so a full-strength L0 penalty never hits
+    random-init reconstructions."""
     total = cfg.total_steps
     warmup = cfg.l1_warmup_frac * total
 
     def f(step):
         step = jnp.asarray(step, dtype=jnp.float32)
         if warmup <= 0:
-            return jnp.full_like(step, cfg.l1_coeff)
-        return cfg.l1_coeff * jnp.minimum(1.0, step / warmup)
+            return jnp.ones_like(step)
+        return jnp.minimum(1.0, step / warmup)
+
+    return f
+
+
+def l1_coeff_schedule(cfg: CrossCoderConfig) -> Schedule:
+    ramp = sparsity_warmup_schedule(cfg)
+
+    def f(step):
+        return cfg.l1_coeff * ramp(step)
 
     return f
 

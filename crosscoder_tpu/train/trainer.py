@@ -59,17 +59,35 @@ def make_train_step(
     reference's host-side ``acts.float() * factor``, reference
     ``buffer.py:123-124``, at half the host→device bytes).
     """
+    if cfg.batchtopk_threshold > 0:
+        # the frozen threshold is EVAL-only (calibrate_batchtopk_threshold):
+        # training with it would ignore topk_k and never adapt as weights
+        # move — refuse rather than silently train a different objective
+        raise ValueError(
+            "cfg.batchtopk_threshold is an eval-mode setting; clear it "
+            "(0.0) before building a train step"
+        )
     lr_fn = schedules.lr_schedule(cfg)
     l1_fn = schedules.l1_coeff_schedule(cfg)
     loss_fn = functools.partial(cc.training_loss, cfg=cfg, with_metrics=with_metrics)
     if cfg.remat:
         loss_fn = jax.checkpoint(loss_fn)
 
+    warm_fn = schedules.sparsity_warmup_schedule(cfg)
+
     def step_fn(state: TrainState, batch: jax.Array, scale: jax.Array):
         x = batch.astype(jnp.float32) * scale[None, :, None]
         l1_coeff = l1_fn(state.step)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, losses), grads = grad_fn(state.params, x, l1_coeff)
+        if cfg.l0_coeff > 0:
+            # L0 warms up over the same window as L1 (reference
+            # trainer.py:34-39's ramp, applied to both sparsity terms)
+            (loss, losses), grads = grad_fn(
+                state.params, x, l1_coeff,
+                l0_coeff=cfg.l0_coeff * warm_fn(state.step),
+            )
+        else:
+            (loss, losses), grads = grad_fn(state.params, x, l1_coeff)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {

@@ -245,3 +245,14 @@ def test_from_hf_local_checkpoint_roundtrip(tmp_path):
     with torch.no_grad():
         want = model.float()(torch.from_numpy(tok)).logits.numpy()
     np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-2, atol=2e-2)
+
+
+def test_gemma2_family_named_configs():
+    """All three family members map by name; the 27B's query scale is
+    d_model/n_heads (144), unlike 2B/9B's head_dim (256)."""
+    c27 = lm.config_for("google/gemma-2-27b")
+    assert c27.d_model == 4608 and c27.n_layers == 46
+    assert c27.query_pre_attn_scalar == 144.0
+    assert c27.head_dim == 128 and c27.n_heads == 32
+    assert lm.config_for("gemma-2-27b-it") == c27
+    assert lm.config_for("gemma-2-9b").query_pre_attn_scalar == 256.0

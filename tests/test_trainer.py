@@ -181,3 +181,34 @@ def test_raw_bf16_source_matches_fp32_source():
     a.close(); b.close()
     for k in pa:
         assert np.allclose(np.asarray(pa[k]), np.asarray(pb[k]), atol=1e-6), k
+
+
+def test_l0_coeff_warmup_in_trainer():
+    """cfg.l0_coeff trains through the jitted step with the L1-style
+    warmup: step 0 applies zero L0 penalty (pre-increment convention),
+    later steps a growing one; loss stays finite and L0 falls vs the
+    no-penalty run over the same steps."""
+    from crosscoder_tpu.train.trainer import Trainer
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+
+    def run(l0_coeff):
+        cfg = CrossCoderConfig(
+            d_in=16, dict_size=128, n_models=2, batch_size=64,
+            activation="jumprelu", jumprelu_theta=0.01,
+            jumprelu_bandwidth=0.05, l1_coeff=0.0, l0_coeff=l0_coeff,
+            enc_dtype="fp32", num_tokens=64 * 400, lr=1e-2,
+            l1_warmup_frac=0.1, log_backend="null",
+        )
+        tr = Trainer(cfg, mesh=mesh_lib.mesh_from_cfg(cfg))
+        m0 = tr.step()
+        # warmup(0) = 0: the first step's loss must equal l2 + 0 exactly
+        assert float(jax.device_get(m0["loss"])) == float(jax.device_get(m0["l2_loss"]))
+        for _ in range(150):
+            m = tr.step(full_metrics=False)
+        m = tr.step()
+        l0 = float(jax.device_get(m["l0_loss"]))
+        assert np.isfinite(float(jax.device_get(m["loss"])))
+        tr.close()
+        return l0
+
+    assert run(5e-2) < run(0.0)
