@@ -1,6 +1,6 @@
 """Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Four sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
+Five sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
 exactly ONE JSON line on stdout):
 
 - **step**: the bare train step on device-resident batches (round-1's
@@ -8,6 +8,8 @@ exactly ONE JSON line on stdout):
 - **matrix**: the sparse tier at the training-step level — activation
   {relu, topk dense, topk pallas, topk+sparse_decode} × dict
   {2^15, 2^16, 2^17} (BASELINE.json config 2 is TopK k=32 @ 2^15).
+- **configs**: all five BASELINE.json scale-out configs at the
+  train-step level (ref shape / topk / 9B-width / 3-way / multi-layer).
 - **e2e**: the pipeline the reference actually runs (reference
   buffer.py:66-122 + trainer.py:41-49): harvest→buffer→train, Gemma-2-2B
   shapes, interleaved incremental refill. Harvest uses REAL-SHAPE random
