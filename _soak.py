@@ -18,7 +18,11 @@ cfg = CrossCoderConfig(
     batch_size=4096, buffer_mult=32, model_batch_size=4, norm_calib_batches=4,
     seq_len=1024, hook_point=f"blocks.{hook_layer}.hook_resid_pre",
     num_tokens=10**12, save_every=10**9, prefetch=True, enc_dtype="bf16",
-    master_dtype="bf16", dict_size=2**15, log_backend="null",
+    master_dtype="bf16", log_backend="null",
+    dict_size=int(os.environ.get("SOAK_DICT", 2**15)),
+    activation=os.environ.get("SOAK_ACT", "relu"),
+    topk_k=32,
+    l1_coeff=0.0 if os.environ.get("SOAK_ACT") == "topk" else 2.0,
     buffer_device="hbm", refill_frac=0.5, checkpoint_dir="/tmp/soak_ck",
 )
 mesh = mesh_lib.make_mesh(data_axis_size=1, model_axis_size=1)
