@@ -246,3 +246,26 @@ def test_analysis_paths_take_tp_params():
     d2 = FeatureVisData.create(cc_params, ccfg, lm_cfg, tp_pair, toks, vis_cfg)
     for f1, f2 in zip(d1.features, d2.features):
         np.testing.assert_allclose(f2.max_act, f1.max_act, rtol=1e-3, atol=1e-5)
+
+
+def test_tp_forward_never_allgathers_weights():
+    """The TP layout's memory claim depends on GSPMD keeping weights
+    sharded through the forward — annotations alone don't guarantee it.
+    Assert the compiled HLO contains no weight-sized all-gather (the
+    collectives it does insert are activation-sized psums/gathers)."""
+    from jax.sharding import Mesh
+
+    lm_cfg = lm.LMConfig.tiny().replace(d_ff=256)   # weights unmistakable
+    params = lm.init_params(jax.random.key(0), lm_cfg)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+    tp = lm.shard_params_tp(params, mesh)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 257, (8, 24), dtype=np.int64)
+    )
+    fn = jax.jit(lambda p, t: lm.forward(p, t, lm_cfg,
+                                         capture=("blocks.2.hook_resid_pre",)))
+    hlo = fn.lower(tp, toks).compile().as_text()
+    gathers = [l for l in hlo.splitlines() if "all-gather" in l]
+    # full w_gate/w_up would be [..,32,256] (or transposed); none may appear
+    offenders = [l for l in gathers if "32,256" in l or "256,32" in l]
+    assert not offenders, offenders
