@@ -1,0 +1,39 @@
+"""bench.py must never rot: the driver runs it at every round end to
+produce the scored headline. This smoke runs the real script (subprocess,
+CPU, tiny shapes) and checks the output contract — exactly one JSON line
+on stdout with the headline fields."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_ROOT = Path(__file__).parent.parent
+
+
+@pytest.mark.slow
+def test_bench_emits_one_json_headline():
+    env = dict(os.environ)
+    env.update(
+        BENCH_TINY="1", BENCH_CPU="1",
+        BENCH_SECTIONS="step,e2e",
+        BENCH_STEPS="4", BENCH_E2E_STEPS="4",
+        BENCH_DIN="32", BENCH_DICT="256", BENCH_BATCH="64",
+        JAX_PLATFORMS="cpu",
+    )
+    env.pop("XLA_FLAGS", None)          # 1-device CPU: cheap and stable
+    r = subprocess.run(
+        [sys.executable, "bench.py"], cwd=str(_ROOT), env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [l for l in r.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got {lines}"
+    out = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in out, key
+    assert out["value"] and out["value"] > 0
+    assert out["e2e"]["loss_finite"] is True

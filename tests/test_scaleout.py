@@ -266,6 +266,20 @@ def test_tp_forward_never_allgathers_weights():
                                          capture=("blocks.2.hook_resid_pre",)))
     hlo = fn.lower(tp, toks).compile().as_text()
     gathers = [l for l in hlo.splitlines() if "all-gather" in l]
-    # full w_gate/w_up would be [..,32,256] (or transposed); none may appear
-    offenders = [l for l in gathers if "32,256" in l or "256,32" in l]
+    # derive every FULL (unsharded) weight shape from the config — the
+    # layer-stacked leading dim keeps these from colliding with
+    # activation shapes like [B,S,d_model]
+    L, D, F = lm_cfg.n_layers, lm_cfg.d_model, lm_cfg.d_ff
+    qd = lm_cfg.n_heads * lm_cfg.head_dim
+    kd = lm_cfg.n_kv_heads * lm_cfg.head_dim
+    weight_shapes = [
+        f"{L},{D},{F}", f"{L},{F},{D}",            # w_gate/w_up, w_down
+        f"{L},{D},{qd}", f"{L},{qd},{D}",          # wq, wo
+        f"{L},{D},{kd}",                            # wk/wv
+        f"{lm_cfg.vocab_size},{D}",                 # embed
+    ]
+    offenders = [l for l in gathers if any(w in l for w in weight_shapes)]
     assert not offenders, offenders
+    # the assertion must not be vacuous: GSPMD does insert activation
+    # collectives in this program
+    assert gathers, "expected activation-sized all-gathers in the TP HLO"
