@@ -65,3 +65,50 @@ def relative_norm_histogram(
     (reference ``analysis.py:16-32`` uses 200 bins)."""
     r = relative_norms(params, pair)
     return jnp.histogram(r, bins=bins, range=(0.0, 1.0))
+
+
+def firing_rates(params, cfg, batches) -> "np.ndarray":
+    """Per-latent firing rate over activation batches: the fraction of rows
+    on which each latent is strictly positive — the feature-density
+    statistic sae_vis reports per feature (reference nb:cells 36-42), here
+    for the WHOLE dictionary at once. Each batch reduces on device to one
+    ``[dict_size]`` int32 vector; the host accumulates in int64, so
+    streaming arbitrarily many rows can never wrap a counter.
+
+    ``batches``: iterable of ``[B, n_sources, d_in]`` rows, normalized as
+    training rows were.
+    """
+    import functools
+
+    import jax
+    import numpy as np
+
+    from crosscoder_tpu.models import crosscoder as cc
+
+    # params as an ARGUMENT (static fn identity via cached_apply): closing
+    # over them would bake the weights into the program as constants
+    @functools.partial(jax.jit, static_argnames=("enc",))
+    def batch_counts(enc, p, x):
+        f = enc(p, jnp.asarray(x))
+        return jnp.sum((f > 0).astype(jnp.int32), axis=0)
+
+    enc = cc.cached_apply(cfg, "encode")
+    count = np.zeros((cfg.dict_size,), np.int64)
+    n = 0
+    for b in batches:
+        count += np.asarray(jax.device_get(batch_counts(enc, params, b)),
+                            np.int64)
+        n += b.shape[0]
+    if n == 0:
+        raise ValueError("firing_rates needs at least one batch")
+    return count.astype(np.float64) / n
+
+
+def dead_latent_fraction(rates) -> float:
+    """Fraction of latents that never fired — the health metric for sparse
+    dictionaries (dead latents waste capacity; TopK/JumpReLU runs watch
+    this)."""
+    import numpy as np
+
+    r = np.asarray(rates)
+    return float((r == 0).mean())

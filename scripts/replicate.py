@@ -86,6 +86,41 @@ def ce_stage(tokens, lm_cfg, model_params, hook_point, folded_params, cfg, chunk
     )
 
 
+def firing_stage(folded_params, cfg, lm_cfg, model_params, tokens,
+                 hook_point) -> dict:
+    """Whole-dictionary feature-density stats (sae_vis reports these per
+    feature, nb:cells 36-42): firing rates over harvested rows + the
+    dead-latent fraction. Folded params take RAW rows (factors are baked
+    into the weights)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crosscoder_tpu.analysis.decoder import dead_latent_fraction, firing_rates
+    from crosscoder_tpu.models import lm as lm_mod
+
+    toks = tokens[:16]
+    n_models = len(model_params)
+
+    def row_batches(chunk=4):
+        # chunked harvest, same memory envelope as the CE stage's chunk=4
+        for start in range(0, toks.shape[0], chunk):
+            acts = lm_mod.run_with_cache_multi(
+                model_params, jnp.asarray(toks[start:start + chunk]),
+                lm_cfg, (hook_point,),
+            )
+            yield np.asarray(jax.device_get(acts))[:, 1:].reshape(
+                -1, n_models, lm_cfg.d_model)
+
+    rates = firing_rates(folded_params, cfg, row_batches())
+    n_rows = toks.shape[0] * (toks.shape[1] - 1)
+    return {
+        "n_rows": int(n_rows),
+        "dead_latent_frac": dead_latent_fraction(rates),
+        "median_rate": float(np.median(rates)),
+        "p95_rate": float(np.percentile(rates, 95)),
+    }
+
+
 def dashboards_stage(folded_params, cfg, lm_cfg, model_params, tokens,
                      hook_point, features, out_dir: Path,
                      tokenizer=None) -> dict:
@@ -208,15 +243,20 @@ def run(args) -> dict:
         print("[replicate] stage 3: CE-recovered table ...")
         report["ce"] = ce_stage(eval_tokens, lm_cfg, model_params, hook,
                                 folded, cfg, chunk=args.chunk)
-        print("[replicate] stage 4: dashboards ...")
+        print("[replicate] stage 4: firing rates ...")
+        report["firing"] = firing_stage(folded, cfg, lm_cfg, model_params,
+                                        eval_tokens, hook)
+        print("[replicate] stage 5: dashboards ...")
         report["dashboards"] = dashboards_stage(
             folded, cfg, lm_cfg, model_params, eval_tokens, hook,
             pick_features(params), out_dir, tokenizer=args.tokenizer)
     else:
         report["ce"] = {}
+        report["firing"] = {}
         report["dashboards"] = {}
-        report["skipped"] = ("CE/dashboards need LM weights + tokens "
-                             "(--tokens, and --norm-factors for --version-dir)")
+        report["skipped"] = ("CE/firing-rates/dashboards need LM weights + "
+                             "tokens (--tokens, and --norm-factors for "
+                             "--version-dir)")
 
     report["published"] = PUBLISHED
     report["checks"] = compare(report)

@@ -187,3 +187,28 @@ def test_replicate_script_demo_smoke(tmp_path):
     assert "ce_recovered_A" in report["ce"]
     assert (out / "dashboards.html").exists()
     assert "checks" in report and "all_pass" in report["checks"]
+
+
+def test_firing_rates_and_dead_fraction():
+    """firing_rates counts strictly-positive latent activations per row;
+    a latent whose encoder row is strongly negative never fires and shows
+    up in dead_latent_fraction."""
+    from crosscoder_tpu.analysis.decoder import dead_latent_fraction, firing_rates
+    from crosscoder_tpu.config import CrossCoderConfig
+    from crosscoder_tpu.models import crosscoder as cc
+
+    cfg = CrossCoderConfig(d_in=8, dict_size=32, n_models=2, batch_size=16,
+                           enc_dtype="fp32")
+    params = dict(cc.init_params(jax.random.key(0), cfg))
+    # kill latent 5: large negative bias guarantees pre-act < 0 everywhere
+    params["b_enc"] = params["b_enc"].at[5].set(-1e6)
+    batches = [np.asarray(jax.random.normal(jax.random.key(i), (16, 2, 8)))
+               for i in range(3)]
+    rates = firing_rates(params, cfg, batches)
+    assert rates.shape == (32,)
+    assert rates[5] == 0.0
+    assert 0.0 <= rates.min() and rates.max() <= 1.0
+    # oracle: direct encode over the concatenated batches
+    f = np.asarray(cc.encode(params, jnp.asarray(np.concatenate(batches)), cfg))
+    np.testing.assert_allclose(rates, (f > 0).mean(0), atol=1e-12)
+    assert dead_latent_fraction(rates) >= 1 / 32
