@@ -53,6 +53,12 @@ class FeatureVisConfig:
     window: int = 24                     # tokens shown around the peak
     logit_lens_k: int = 10               # promoted/suppressed tokens per table
     include_logit_lens: bool = True      # the fork's logit tables (nb:cells 33-42)
+    # sae_vis-style interval sequence groups (nb:cells 36-42): besides the
+    # top-k max-activating group, sample sequences whose PEAK activation
+    # falls in each of n equal bands of (0, max_act] — the mid/low-strength
+    # firing contexts a top-k-only view hides. 0 disables.
+    n_quantile_groups: int = 4
+    seqs_per_group: int = 4
 
     def __post_init__(self) -> None:
         self.features = tuple(int(f) for f in self.features)
@@ -68,6 +74,8 @@ class FeatureData:
     acts_sample: np.ndarray              # nonzero activations (density plot)
     top_seqs: list[dict] = field(default_factory=list)
     # each: {tokens: [int], values: [float], peak: int}
+    quantile_groups: list[dict] = field(default_factory=list)
+    # each: {label: str, lo: float, hi: float, seqs: [same dicts as top_seqs]}
     logit_lens: list[dict] = field(default_factory=list)
     # per source: {source: int, promoted: [(token_id, value)...],
     #              suppressed: [(token_id, value)...]} — the sae_vis fork's
@@ -191,29 +199,60 @@ class FeatureVisData:
         for fi, feat in enumerate(vis_cfg.features):
             a = acts[..., fi]                               # [N, S-1]
             peak_per_seq = a.max(axis=1)
-            order = np.argsort(-peak_per_seq)[: vis_cfg.top_k_sequences]
-            seqs = []
-            for si in order:
-                if peak_per_seq[si] <= 0:
-                    continue
+
+            def seq_entry(si: int) -> dict:
                 peak = int(a[si].argmax())
                 lo = max(0, peak + 1 - vis_cfg.window // 2)
                 hi = min(tokens.shape[1], lo + vis_cfg.window)
-                seqs.append({
+                return {
                     # +1: activation col j scores token j+1 (BOS dropped)
                     "tokens": tokens[si, lo:hi].tolist(),
                     "values": np.concatenate([[0.0], a[si]])[lo:hi].tolist(),
                     "peak": peak + 1 - lo,
-                })
+                }
+
+            order = np.argsort(-peak_per_seq)[: vis_cfg.top_k_sequences]
+            seqs = [seq_entry(si) for si in order if peak_per_seq[si] > 0]
+
+            # interval groups: equal value-bands of (0, max_act]; within a
+            # band, sequences are sampled evenly across the band's sorted
+            # peaks (deterministic, spans the band instead of hugging its
+            # top edge), excluding anything already shown in the top-k group
+            groups: list[dict] = []
+            mx = float(a.max())
+            if vis_cfg.n_quantile_groups > 0 and mx > 0:
+                shown = set(int(si) for si in order)
+                edges = np.linspace(0.0, mx, vis_cfg.n_quantile_groups + 1)
+                for j in range(vis_cfg.n_quantile_groups - 1, -1, -1):
+                    band = np.where(
+                        (peak_per_seq > edges[j]) & (peak_per_seq <= edges[j + 1])
+                    )[0]
+                    band = np.asarray(
+                        [si for si in band[np.argsort(-peak_per_seq[band])]
+                         if int(si) not in shown]
+                    )
+                    if band.size == 0:
+                        continue
+                    take = min(vis_cfg.seqs_per_group, band.size)
+                    sel = band[np.unique(
+                        np.linspace(0, band.size - 1, take).astype(int)
+                    )]
+                    groups.append({
+                        "label": f"interval {edges[j]:.2f}-{edges[j + 1]:.2f}",
+                        "lo": float(edges[j]),
+                        "hi": float(edges[j + 1]),
+                        "seqs": [seq_entry(int(si)) for si in sel],
+                    })
             nz = a[a > 0]
             out.append(FeatureData(
                 feature=int(feat),
-                max_act=float(a.max()),
+                max_act=mx,
                 frac_active=float((a > 0).mean()),
                 relative_norm=float(rel[fi]),
                 cosine_sim=float(cos[fi]),
                 acts_sample=nz[:10_000],
                 top_seqs=seqs,
+                quantile_groups=groups,
                 logit_lens=lens_tables[fi],
             ))
         return cls(vis_cfg, out)
@@ -235,15 +274,29 @@ class FeatureVisData:
 
             decode_fn = decode_fn_from_file(tokenizer)
         render = default_token_renderer(decode_fn)
+
+        def seq_row(seq: dict, vmax: float) -> str:
+            strs = [render(t) for t in seq["tokens"]]
+            return (
+                f'<div class="seq">'
+                f'{tokens_to_html(strs, seq["values"], vmax=vmax, token_ids=seq["tokens"])}'
+                f' <span class="peak">max {max(seq["values"]):.2f}</span></div>'
+            )
+
         cards = []
         for fd in self.features:
-            rows = []
-            for seq in fd.top_seqs:
-                strs = [render(t) for t in seq["tokens"]]
-                rows.append(
-                    f'<div class="seq">{tokens_to_html(strs, seq["values"], vmax=fd.max_act)}'
-                    f' <span class="peak">max {max(seq["values"]):.2f}</span></div>'
-                )
+            rows = [seq_row(seq, fd.max_act) for seq in fd.top_seqs]
+            group_html = ""
+            if fd.quantile_groups:
+                blocks = []
+                for grp in fd.quantile_groups:
+                    grows = "".join(seq_row(s, fd.max_act) for s in grp["seqs"])
+                    blocks.append(
+                        f'<div class="group"><h3>{_html.escape(grp["label"])}'
+                        f' <span class="peak">{len(grp["seqs"])} seqs</span></h3>'
+                        f"{grows}</div>"
+                    )
+                group_html = f'<div class="groups">{"".join(blocks)}</div>'
             hist = (
                 svg_histogram(fd.acts_sample) if fd.acts_sample.size else "<i>never active</i>"
             )
@@ -281,7 +334,9 @@ class FeatureVisData:
   </table>
   <div class="hist">{hist}</div>
   {lens_html}
-  <div class="seqs">{"".join(rows) or "<i>no activating sequences in sample</i>"}</div>
+  <div class="seqs"><h3>top activations</h3>
+  {"".join(rows) or "<i>no activating sequences in sample</i>"}</div>
+  {group_html}
 </div>""")
         doc = f"""<!doctype html><html><head><meta charset="utf-8">
 <title>crosscoder feature dashboards</title>
@@ -299,6 +354,9 @@ class FeatureVisData:
  .lens sub {{ color: #777; font-size: 9px; }}
  .stats td {{ padding: 0 1em 0 0; color: #444; font-size: 13px; }}
  h2 {{ margin: .2em 0 .5em; font-size: 16px; }}
+ h3 {{ margin: .6em 0 .2em; font-size: 13px; color: #555;
+       text-transform: uppercase; letter-spacing: .04em; }}
+ .group {{ border-top: 1px dashed #e5e5e5; }}
 </style></head><body>
 <h1>crosscoder feature dashboards</h1>
 <p>{_html.escape(self.cfg.hook_point)} · {len(self.features)} features</p>

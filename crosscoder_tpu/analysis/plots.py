@@ -78,17 +78,26 @@ def tokens_to_html(
     token_strs: Sequence[str],
     values: Sequence[float],
     vmax: float | None = None,
+    token_ids: Sequence[int] | None = None,
 ) -> str:
     """One sequence as an inline token heatmap — the reference's
     ``create_html`` (``utils.py:96-147``): token background encodes the
-    per-token value, hover shows the number; newlines become visible '↵'."""
+    per-token value, hover shows the detail; newlines become visible '↵'.
+
+    ``token_ids`` enriches each token's hover tooltip with its id (the
+    sae_vis fork's per-token hover detail, nb:cells 36-42) — useful when a
+    rendered string is ambiguous (whitespace variants, byte fallbacks)."""
     vals = np.asarray(values, dtype=np.float32)
     vmax = float(vals.max()) if vmax is None else vmax
     spans = []
-    for tok, v in zip(token_strs, vals):
+    ids = [None] * len(vals) if token_ids is None else token_ids
+    for tok, v, tid in zip(token_strs, vals, ids):
         shown = tok.replace("\n", "↵")
+        title = f"{float(v):.3f}"
+        if tid is not None:
+            title = f"{_html.escape(shown)} · id {int(tid)} · act {title}"
         spans.append(
-            f'<span title="{float(v):.3f}" style="background:{_act_color(float(v), vmax)};'
+            f'<span title="{title}" style="background:{_act_color(float(v), vmax)};'
             f'border-radius:2px;padding:0 1px">{_html.escape(shown)}</span>'
         )
     return "".join(spans)

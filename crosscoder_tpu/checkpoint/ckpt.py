@@ -26,6 +26,8 @@ wrong slots. Old positional (``leaf_i``) saves still load.
 from __future__ import annotations
 
 import json
+import os
+import threading
 from pathlib import Path
 from typing import Any
 
@@ -35,6 +37,26 @@ import numpy as np
 from crosscoder_tpu.config import CrossCoderConfig
 
 
+def _atomic_savez(path: Path, arrays: dict[str, np.ndarray]) -> None:
+    """npz write that becomes visible all-or-nothing: stream into a
+    ``.tmp`` sibling, then ``os.replace`` (atomic on POSIX). A process
+    killed mid-write leaves only the tmp file, which every reader path
+    (``latest_save``/``restore``) ignores."""
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def _atomic_write_text(path: Path, text: str) -> None:
+    """Atomic sibling of :func:`_atomic_savez` for the JSON artifacts — the
+    meta file is the save's completion marker, so it especially must never
+    exist half-written."""
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
 class Checkpointer:
     def __init__(self, base_dir: str | Path | None = None, cfg: CrossCoderConfig | None = None) -> None:
         if base_dir is None:
@@ -42,6 +64,20 @@ class Checkpointer:
         self.base_dir = Path(base_dir)
         self.save_dir: Path | None = None
         self.save_version = 0
+        # background-write state (save(background=True)): one writer thread
+        # at a time; wait() joins it and re-raises any write failure
+        self._writer: threading.Thread | None = None
+        self._writer_error: BaseException | None = None
+
+    def wait(self) -> None:
+        """Block until any in-flight background write has finished; raises
+        the write's exception here if it failed."""
+        if self._writer is not None:
+            self._writer.join()
+            self._writer = None
+        if self._writer_error is not None:
+            err, self._writer_error = self._writer_error, None
+            raise err
 
     # --- directory management (reference crosscoder.py:132-145 semantics) ---
     def _create_save_dir(self) -> None:
@@ -81,11 +117,30 @@ class Checkpointer:
         return {jax.tree_util.keystr(p): cls._fetch_global(leaf) for p, leaf in paths}
 
     # --- save ---------------------------------------------------------------
-    def save(self, state: Any, cfg: CrossCoderConfig, buffer: Any | None = None) -> Path:
-        """Write one versioned save; returns the weights path.
+    def save(
+        self,
+        state: Any,
+        cfg: CrossCoderConfig,
+        buffer: Any | None = None,
+        background: bool = False,
+    ) -> Path | None:
+        """Write one versioned save; returns the weights path, or ``None``
+        on a non-primary process (which never touches the filesystem, so
+        there is no real path to hand back).
 
         EVERY process must call this on a multi-host mesh (the state fetch
         is collective); only process 0 touches the filesystem.
+
+        ``background=True`` overlaps the file write with training: the
+        device→host fetch (the part that must see a consistent state)
+        stays synchronous, then a single writer thread streams the ~GBs to
+        disk while the step loop resumes — at production shape (dict 2^16,
+        fp32 masters) the write is most of the save, so periodic saves
+        stop stalling steps and the SIGTERM preemption window shrinks to
+        the fetch. Writes are serialized (a new save waits for the
+        previous write) and atomic (tmp + ``os.replace``, meta last, so a
+        kill mid-write never leaves a torn save that ``restore`` could
+        read). Call :meth:`wait` (Trainer.close does) before process exit.
         """
         # collective fetches first, identical order on all processes; each
         # leaf crosses the network ONCE — the weights artifact reuses the
@@ -99,6 +154,10 @@ class Checkpointer:
                 out = self._fetch_global(leaf)
                 fetched[id(leaf)] = out
             return out
+
+        # serialize with any in-flight background write BEFORE fetching:
+        # one writer at a time, and a prior failure surfaces here
+        self.wait()
 
         pathed = jax.tree_util.tree_flatten_with_path(state)[0]
         flat_state = {jax.tree_util.keystr(p): fetch(leaf) for p, leaf in pathed}
@@ -115,36 +174,105 @@ class Checkpointer:
         if buffer is not None and hasattr(buffer, "state_dict"):
             meta["buffer"] = buffer.state_dict()
         if primary:
-            np.savez(self.save_dir / f"{v}.npz", **weights)
-            cfg.to_json(self.save_dir / f"{v}_cfg.json")
-            np.savez(self.save_dir / f"{v}_train_state.npz", **flat_state)
-            (self.save_dir / f"{v}_meta.json").write_text(json.dumps(meta, indent=2))
-            print(f"Saved as version {v} in {self.save_dir}")
+            save_dir = self.save_dir
+
+            def write() -> None:
+                _atomic_savez(save_dir / f"{v}.npz", weights)
+                _atomic_write_text(save_dir / f"{v}_cfg.json", cfg.to_json_str())
+                _atomic_savez(save_dir / f"{v}_train_state.npz", flat_state)
+                # meta LAST: its presence marks the save complete —
+                # latest_save keys off it, so a torn save is unreadable
+                _atomic_write_text(
+                    save_dir / f"{v}_meta.json", json.dumps(meta, indent=2)
+                )
+                print(f"Saved as version {v} in {save_dir}")
+
+            if background:
+                def guarded() -> None:
+                    try:
+                        write()
+                    except BaseException as e:  # surfaced by the next wait()
+                        self._writer_error = e
+
+                self._writer = threading.Thread(
+                    target=guarded, name="ckpt-writer", daemon=False
+                )
+                self._writer.start()
+            else:
+                write()
         self.save_version += 1
         if self.save_dir is None:
-            return Path(f"<process {jax.process_index()}: primary writes>")
+            return None
         return self.save_dir / f"{v}.npz"
 
     # --- load/restore -------------------------------------------------------
     @staticmethod
-    def latest_version_dir(base_dir: str | Path) -> Path:
+    def _version_dirs(base_dir: str | Path) -> list[Path]:
         base = Path(base_dir)
-        versions = sorted(
-            (int(p.name.split("_")[1]), p)
-            for p in base.iterdir()
-            if p.is_dir() and p.name.startswith("version_") and p.name.split("_")[1].isdigit()
-        )
+        return [
+            p for _, p in sorted(
+                (int(p.name.split("_")[1]), p)
+                for p in base.iterdir()
+                if p.is_dir() and p.name.startswith("version_")
+                and p.name.split("_")[1].isdigit()
+            )
+        ]
+
+    @classmethod
+    def latest_version_dir(cls, base_dir: str | Path) -> Path:
+        versions = cls._version_dirs(base_dir)
         if not versions:
-            raise FileNotFoundError(f"no version_* dirs under {base}")
-        return versions[-1][1]
+            raise FileNotFoundError(f"no version_* dirs under {base_dir}")
+        return versions[-1]
 
     @staticmethod
-    def latest_save(version_dir: str | Path) -> int:
-        saves = [
-            int(p.stem)
-            for p in Path(version_dir).glob("*.npz")
-            if p.stem.isdigit()
-        ]
+    def complete_saves(version_dir: str | Path) -> list[int]:
+        """Saves whose meta (written LAST, atomically) exists — the only
+        ones ``restore`` will touch; a save torn mid-write has no meta."""
+        return sorted(
+            int(p.name.split("_")[0])
+            for p in Path(version_dir).glob("*_meta.json")
+            if p.name.split("_")[0].isdigit()
+        )
+
+    @classmethod
+    def _latest_resumable_dir(cls, base_dir: str | Path) -> Path:
+        """Newest version dir holding at least one COMPLETE save. A fresh
+        run preempted during its very first save leaves a version dir with
+        only torn artifacts — auto-resume must fall back to the previous
+        run's dir, not crash on the torn one."""
+        versions = cls._version_dirs(base_dir)
+        for vdir in reversed(versions):
+            if cls.complete_saves(vdir):
+                return vdir
+        raise FileNotFoundError(
+            f"no version dir under {base_dir} holds a complete "
+            "(meta-marked) save"
+        )
+
+    @classmethod
+    def latest_save(cls, version_dir: str | Path) -> int:
+        # key off the meta file — it is written LAST (atomically), so its
+        # presence proves the whole save landed; globbing *.npz would pick
+        # a save whose train_state/meta a mid-save kill never wrote
+        saves = cls.complete_saves(version_dir)
+        if not saves:
+            vdir = Path(version_dir)
+            # hand-assembled WEIGHTS-ONLY dirs (converted foreign
+            # checkpoints for the analysis path) carry npz + cfg but
+            # neither meta nor train_state. Anything else meta-less is a
+            # torn save — train_state present (killed before meta), or
+            # weights without their cfg (killed before cfg; load_weights
+            # needs the cfg, so no usable foreign dir lacks it).
+            if list(vdir.glob("*_train_state.npz")):
+                raise FileNotFoundError(
+                    f"only torn (meta-less) saves under {version_dir}"
+                )
+            saves = [
+                int(p.stem)
+                for p in vdir.glob("*.npz")
+                if p.stem.isdigit() and (vdir / f"{p.stem}_cfg.json").exists()
+            ]
         if not saves:
             raise FileNotFoundError(f"no saves under {version_dir}")
         return max(saves)
@@ -168,8 +296,22 @@ class Checkpointer:
         """Rebuild the full TrainState (+ pipeline meta) for resume."""
         from crosscoder_tpu.train.state import init_train_state
 
-        vdir = Path(version_dir) if version_dir else self.latest_version_dir(self.base_dir)
-        v = self.latest_save(vdir) if save is None else save
+        self.wait()  # a background write from THIS instance must land first
+
+        # auto-resume only ever touches COMPLETE saves: the newest version
+        # dir with one, and within it the newest meta-marked save — a save
+        # (or whole fresh-run dir) torn by a mid-write kill is skipped
+        vdir = Path(version_dir) if version_dir else self._latest_resumable_dir(self.base_dir)
+        if save is None:
+            complete = self.complete_saves(vdir)
+            if not complete:
+                raise FileNotFoundError(
+                    f"no complete (meta-marked) save under {vdir}; "
+                    "saves torn mid-write are not resumable"
+                )
+            v = complete[-1]
+        else:
+            v = save
         template = init_train_state(jax.random.key(cfg.seed), cfg, tx)
         pathed, treedef = jax.tree_util.tree_flatten_with_path(template)
         with np.load(vdir / f"{v}_train_state.npz") as z:

@@ -89,6 +89,20 @@ class CrossCoderConfig:
                                     # JumpReLU paper's sparsity objective);
                                     # combine with l1_coeff=0 for pure-L0
                                     # training
+    aux_k: int = 0                  # >0: AuxK dead-latent mitigation (the
+                                    # standard TopK-SAE recipe, Gao et al.
+                                    # 2024): an auxiliary loss reconstructs
+                                    # the main reconstruction's residual
+                                    # with the top aux_k DEAD latents
+                                    # (steps_since_fired >= aux_dead_steps),
+                                    # giving dead latents a gradient path
+                                    # back to life. Typical: 2-16x topk_k.
+    aux_k_coeff: float = 1.0 / 32.0  # weight on the (residual-normalized)
+                                    # aux loss; 1/32 is the Gao et al.
+                                    # default
+    aux_dead_steps: int = 500       # a latent is "dead" after this many
+                                    # consecutive steps without firing
+                                    # (500 steps x batch 4096 ≈ 2M rows)
     batchtopk_threshold: float = 0.0   # >0: batchtopk EVAL mode — a fixed
                                     # global threshold (from
                                     # crosscoder.calibrate_batchtopk_threshold)
@@ -148,6 +162,14 @@ class CrossCoderConfig:
     model_names: tuple[str, ...] = ()  # HF ids to diff; default: (google/<model_name>, +"-it")
     resume: bool = False            # resume from the latest checkpoint version
     prefetch: bool = True           # overlap host batch gather with the device step
+    stop_poll_every: int = 20       # multi-process only: steps between
+                                    # allgathered stop-flag polls (the
+                                    # SIGTERM coordinated stop). Each poll
+                                    # is a host-blocking cross-host
+                                    # collective, so per-step polling
+                                    # would defeat async dispatch; 20
+                                    # bounds the stop latency at ~20 steps
+                                    # while costing <5% of steps a sync.
     # master-weight/Adam-moment dtype. fp32 (default) is a quality upgrade
     # over the reference; "bf16" reproduces the reference exactly (its params
     # AND torch-Adam moments are bf16, train.py:5 + crosscoder.py:30-34) and
@@ -220,6 +242,14 @@ class CrossCoderConfig:
                 f"batchtopk_threshold requires activation='batchtopk', "
                 f"got {self.activation!r}"
             )
+        if self.aux_k < 0:
+            raise ValueError(f"aux_k must be >= 0, got {self.aux_k}")
+        if self.aux_k > self.dict_size:
+            raise ValueError(
+                f"aux_k {self.aux_k} cannot exceed dict_size {self.dict_size}"
+            )
+        if self.aux_k > 0 and self.aux_dead_steps < 1:
+            raise ValueError("aux_dead_steps must be >= 1 when aux_k > 0")
 
     # --- derived quantities -------------------------------------------------
     @property
@@ -259,8 +289,13 @@ class CrossCoderConfig:
         d.update(extras)
         return d
 
+    def to_json_str(self) -> str:
+        """The single serialized form — every cfg JSON writer (to_json, the
+        checkpointer's atomic write) goes through this."""
+        return json.dumps(self.to_dict(), indent=2)
+
     def to_json(self, path: str | Path) -> None:
-        Path(path).write_text(json.dumps(self.to_dict(), indent=2))
+        Path(path).write_text(self.to_json_str())
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "CrossCoderConfig":

@@ -143,6 +143,18 @@ class PairedActivationBuffer:
                 f"buffer_size {self.buffer_size} < 2×batch_size; raise buffer_mult"
             )
 
+        # every harvest forward runs at this fixed sequence count: a multiple
+        # of the mesh data-axis size (sharding divisibility) >= the requested
+        # model_batch_size — one compile shape, ragged tails padded. Under
+        # seq_shards the data axis carries the SEQUENCE, so the batch axis
+        # has no divisibility constraint. Computed BEFORE _alloc_store so
+        # store implementations can validate harvest-chunk divisibility at
+        # construction (MeshPairedActivationBuffer does).
+        data_axis = 1
+        if batch_sharding is not None and self._seq_mesh is None:
+            data_axis = int(batch_sharding.mesh.shape.get("data", 1))
+        self._chunk_seqs = -(-cfg.model_batch_size // data_axis) * data_axis
+
         self._alloc_store()
         self._perm = np.arange(self.buffer_size)
         self._rng = np.random.default_rng(cfg.seed)
@@ -154,16 +166,6 @@ class PairedActivationBuffer:
         self._src_global = np.zeros(self.buffer_size, dtype=np.int64)
         self.first = True
         self._filled = False
-
-        # every harvest forward runs at this fixed sequence count: a multiple
-        # of the mesh data-axis size (sharding divisibility) >= the requested
-        # model_batch_size — one compile shape, ragged tails padded. Under
-        # seq_shards the data axis carries the SEQUENCE, so the batch axis
-        # has no divisibility constraint.
-        data_axis = 1
-        if batch_sharding is not None and self._seq_mesh is None:
-            data_axis = int(batch_sharding.mesh.shape.get("data", 1))
-        self._chunk_seqs = -(-cfg.model_batch_size // data_axis) * data_axis
 
         if not lazy:
             # lazy=True defers calibration+fill to load_state_dict() so a
@@ -781,6 +783,18 @@ class MeshPairedActivationBuffer(DevicePairedActivationBuffer):
             raise ValueError(
                 f"batch_size {cfg.batch_size} must divide by the mesh data "
                 f"axis {n_shards} for the sharded-store serve path"
+            )
+        # batch-sharded harvest chunks ride an all_gather(tiled=True) over
+        # the data axis in the scatter — their row dim must divide by it.
+        # The base class's _chunk_seqs round-up guarantees this; validate
+        # here so any misconfiguration (or a change to that padding) fails
+        # at construction like the other guards, not as a shard_map spec
+        # error at the first drain.
+        if self._seq_mesh is None and self._chunk_seqs % n_shards:
+            raise ValueError(
+                f"harvest chunk of {self._chunk_seqs} seqs must divide by "
+                f"the mesh data axis {n_shards} for the batch-sharded "
+                f"scatter (model_batch_size={cfg.model_batch_size})"
             )
         self._rows_local = -(-self.buffer_size // n_shards)
         self._store_size = self._rows_local * n_shards

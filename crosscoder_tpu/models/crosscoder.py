@@ -59,6 +59,12 @@ class LossOutput(NamedTuple):
     # jumprelu + cfg.l0_coeff only: the rectangle-kernel-STE L0 penalty
     # term (differentiable in θ; equals l0_loss in value). 0.0 elsewhere.
     l0_penalty: jax.Array | float = 0.0
+    # AuxK only (cfg.aux_k > 0 and a dead_mask was passed): the
+    # residual-normalized auxiliary reconstruction loss over dead latents,
+    # and the [d_hidden] bool of latents that fired on this batch (the
+    # trainer's steps_since_fired update). 0.0 / None elsewhere.
+    aux_loss: jax.Array | float = 0.0
+    fired: jax.Array | None = None
 
 
 def init_params(key: jax.Array, cfg: CrossCoderConfig, dtype: jnp.dtype | None = None) -> Params:
@@ -132,14 +138,17 @@ def calibrate_batchtopk_threshold(
     import numpy as np
 
     @jax.jit
-    def one(x):
+    def one(p, x):
         # cast like training does (fp32 masters -> enc_dtype): the order
-        # statistic must come from the same bf16 pre-acts training saw
-        cp = cast_params(params, dtype_of(cfg.enc_dtype))
+        # statistic must come from the same bf16 pre-acts training saw.
+        # params are a traced argument (not a closure) so the dictionary
+        # weights are not baked into the executable as constants — same
+        # trap documented at decoder.firing_rates / ce_eval.
+        cp = cast_params(p, dtype_of(cfg.enc_dtype))
         hp = jax.nn.relu(pre_acts(cp, x.astype(dtype_of(cfg.enc_dtype))))
         return act_ops.batchtopk_threshold_of(hp, cfg.topk_k)
 
-    vals = [float(jax.device_get(one(jnp.asarray(b)))) for b in batches]
+    vals = [float(jax.device_get(one(params, jnp.asarray(b)))) for b in batches]
     if not vals:
         raise ValueError("calibrate_batchtopk_threshold needs >= 1 batch")
     return float(np.mean(vals))
@@ -278,7 +287,11 @@ def sparse_topk_forward(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> 
 
 
 def get_losses(
-    params: Params, x: jax.Array, cfg: CrossCoderConfig, with_metrics: bool = True
+    params: Params,
+    x: jax.Array,
+    cfg: CrossCoderConfig,
+    with_metrics: bool = True,
+    dead_mask: jax.Array | None = None,
 ) -> LossOutput:
     """Full loss surface for a batch ``x: [batch, n_sources, d_in]``.
 
@@ -338,6 +351,42 @@ def get_losses(
         ff = f.astype(jnp.float32)
         l1_loss = jnp.mean(jnp.sum(ff * total_dec_norm[None, :], axis=-1))
 
+    # --- AuxK (cfg.aux_k > 0; Gao et al. 2024 "Scaling and evaluating
+    # sparse autoencoders", the standard TopK-SAE dead-latent recipe; no
+    # reference counterpart — the reference's dense ReLU never faces mass
+    # latent death). Reconstruct the MAIN reconstruction's residual
+    # e = stop_grad(x − x̂) with the top aux_k latents among those the
+    # trainer marked dead, decoded through W_dec without b_dec; the loss is
+    # normalized by the residual's own power so cfg.aux_k_coeff stays
+    # dimensionless as the residual shrinks. Raw (un-ReLU'd) pre-acts are
+    # ranked/decoded — a dead latent's pre-act is usually ≤ 0, and ReLU
+    # would zero exactly the gradient path this loss exists to provide.
+    # Objective-relevant, so computed in the with_metrics=False step too.
+    aux_loss: jax.Array | float = 0.0
+    fired = None
+    if dead_mask is not None and cfg.aux_k > 0:
+        d_hidden = params["W_dec"].shape[0]
+        if sparse:
+            hits = jnp.zeros((d_hidden,), jnp.int32).at[idx.reshape(-1)].add(
+                (vals.reshape(-1) > 0).astype(jnp.int32), mode="drop"
+            )
+            fired = hits > 0
+        else:
+            fired = jnp.any(ff > 0, axis=0)
+        k_aux = min(cfg.aux_k, d_hidden)
+        h_all = pre_acts(params, x).astype(jnp.float32)   # CSE'd with encode
+        masked = jnp.where(dead_mask[None, :], h_all, -jnp.inf)
+        avals, aidx = jax.lax.top_k(masked, k_aux)
+        # fewer dead than aux_k → -inf rows; zero them (no value, no grad)
+        avals = jnp.where(jnp.isfinite(avals), avals, 0.0).astype(x.dtype)
+        e = jax.lax.stop_gradient(xf - rf)                # [B, n, d] fp32
+        e_hat = _sparse_decode_product(avals, aidx, params["W_dec"])
+        num = jnp.mean(jnp.sum(jnp.square(e_hat - e), axis=(-2, -1)))
+        den = jnp.mean(jnp.sum(jnp.square(e), axis=(-2, -1)))
+        # no dead latents → e_hat ≡ 0 and the ratio is a gradient-free
+        # constant ≈ 1; gate it to 0 so loss/metrics don't carry the ghost
+        aux_loss = jnp.where(jnp.any(dead_mask), num / (den + 1e-8), 0.0)
+
     if not with_metrics:
         zero = jnp.zeros((), jnp.float32)
         return LossOutput(
@@ -349,6 +398,8 @@ def get_losses(
                 (x.shape[-2], x.shape[0]), jnp.float32
             ),
             l0_penalty=l0_penalty,
+            aux_loss=aux_loss,
+            fired=fired,
         )
 
     eps = 1e-8
@@ -374,6 +425,8 @@ def get_losses(
         explained_variance=explained_variance,
         explained_variance_per_source=jnp.transpose(ev_per_source),
         l0_penalty=l0_penalty,
+        aux_loss=aux_loss,
+        fired=fired,
     )
 
 
@@ -392,6 +445,8 @@ def training_loss(
     cfg: CrossCoderConfig,
     with_metrics: bool = True,
     l0_coeff: jax.Array | float | None = None,
+    dead_mask: jax.Array | None = None,
+    aux_coeff: jax.Array | float | None = None,
 ) -> tuple[jax.Array, LossOutput]:
     """Scalar training objective ``l2 + l1_coeff · l1`` (reference
     ``trainer.py:44``) plus the full loss surface as aux.
@@ -400,7 +455,8 @@ def training_loss(
     the einsums hit the MXU in bf16 while gradients accumulate into fp32.
     """
     losses = get_losses(
-        cast_params(params, dtype_of(cfg.enc_dtype)), x, cfg, with_metrics
+        cast_params(params, dtype_of(cfg.enc_dtype)), x, cfg, with_metrics,
+        dead_mask=dead_mask,
     )
     # TopK-style runs control sparsity structurally and typically set
     # l1_coeff=0 in config; the objective shape is the same either way.
@@ -410,6 +466,11 @@ def training_loss(
     if cfg.l0_coeff > 0:
         eff = cfg.l0_coeff if l0_coeff is None else l0_coeff
         loss = loss + eff * losses.l0_penalty
+    if cfg.aux_k > 0 and dead_mask is not None:
+        # AuxK term (``aux_coeff`` overrides cfg.aux_k_coeff — the trainer
+        # passes the sparsity-warmup-scaled value, same ramp as l0_coeff)
+        eff_aux = cfg.aux_k_coeff if aux_coeff is None else aux_coeff
+        loss = loss + eff_aux * losses.aux_loss
     return loss, losses
 
 

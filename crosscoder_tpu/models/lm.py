@@ -260,12 +260,21 @@ def _mlp(x: jax.Array, lp: Mapping[str, jax.Array]) -> jax.Array:
     return jnp.einsum("bsf,fd->bsd", h, lp["w_down"], preferred_element_type=jnp.float32).astype(x.dtype)
 
 
-def _block(resid: jax.Array, lp: Mapping[str, jax.Array], cfg: LMConfig, is_local: jax.Array) -> jax.Array:
-    """One Gemma-2 transformer block (sandwich norms around attn and MLP)."""
+def _block(
+    resid: jax.Array, lp: Mapping[str, jax.Array], cfg: LMConfig, is_local: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One Gemma-2 transformer block (sandwich norms around attn and MLP).
+
+    Returns ``(resid, attn_out, mlp_out)`` — the updated stream plus the two
+    sublayer contributions exactly as they are ADDED to it (post the Gemma-2
+    sandwich post-norms), which is what ``hook_attn_out``/``hook_mlp_out``
+    capture: the intermediates exist anyway, so exposing them is free."""
     a = _attention(_rms_norm(resid, lp["attn_norm"], cfg.rms_eps), lp, cfg, is_local)
-    resid = resid + _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+    attn_out = _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+    resid = resid + attn_out
     m = _mlp(_rms_norm(resid, lp["pre_ffw_norm"], cfg.rms_eps), lp)
-    return resid + _rms_norm(m, lp["post_ffw_norm"], cfg.rms_eps)
+    mlp_out = _rms_norm(m, lp["post_ffw_norm"], cfg.rms_eps)
+    return resid + mlp_out, attn_out, mlp_out
 
 
 # ---------------------------------------------------------------------------
@@ -303,12 +312,23 @@ class Edit:
     value: jax.Array | None = None
 
 
-def _capture_into(buf: jax.Array | None, resid: jax.Array, i, cap_arr) -> jax.Array | None:
-    """Accumulate ``resid`` into the capture slot whose layer equals ``i``
-    (one-hot over slots; shared by the dense and sequence-parallel paths)."""
+# hook-site codes (static, baked into the capture tuples)
+_SITE_RESID, _SITE_ATTN, _SITE_MLP = 0, 1, 2
+
+
+def _capture_into(
+    buf: jax.Array | None, resid: jax.Array, i, cap_arr, site: int = _SITE_RESID,
+    site_arr=None,
+) -> jax.Array | None:
+    """Accumulate ``resid`` into the capture slot whose (layer, site) equals
+    ``(i, site)`` (one-hot over slots; shared by the dense and
+    sequence-parallel paths)."""
     if buf is None:
         return None
-    match = (cap_arr == i).astype(resid.dtype)
+    match = (cap_arr == i)
+    if site_arr is not None:
+        match = match & (site_arr == site)
+    match = match.astype(resid.dtype)
     return buf + match[:, None, None, None] * resid[None]
 
 
@@ -321,23 +341,47 @@ def _unembed(params: LMParams, resid: jax.Array, cfg: LMConfig) -> jax.Array:
     return logits
 
 
-def _hook_layers(cfg: LMConfig, hook_points: Sequence[str]) -> tuple[int, ...]:
-    """Map hook strings to capture layer indices. ``resid_pre`` of layer L is
-    the stream entering block L; ``resid_post`` of L is ``resid_pre`` of L+1
-    (the final layer's post-stream is captured as slot ``n_layers``)."""
-    layers = []
+def _hook_layers(cfg: LMConfig, hook_points: Sequence[str]) -> tuple[tuple[int, int], ...]:
+    """Map hook strings to capture ``(layer, site)`` pairs.
+
+    Residual sites: ``resid_pre`` of layer L is the stream entering block L
+    (slot (L, resid)); ``resid_post`` of L is ``resid_pre`` of L+1 (the
+    final layer's post-stream is slot (n_layers, resid)). Sublayer sites
+    (TransformerLens exposes these; the reference only ever uses
+    ``resid_pre``, reference train.py:32): ``attn_out`` / ``mlp_out`` of
+    layer L are the block's attention/MLP contributions as ADDED to the
+    stream — i.e. after Gemma-2's post-sublayer sandwich norms."""
+    pairs = []
     for hp in hook_points:
         layer, site = parse_hook_point(hp)
         if site == "resid_pre":
-            pass
+            code = _SITE_RESID
         elif site == "resid_post":
-            layer = layer + 1
+            layer, code = layer + 1, _SITE_RESID
+        elif site == "attn_out":
+            code = _SITE_ATTN
+        elif site == "mlp_out":
+            code = _SITE_MLP
         else:
-            raise ValueError(f"unsupported hook site {site!r} (resid_pre/resid_post)")
-        if not 0 <= layer <= cfg.n_layers:
+            raise ValueError(
+                f"unsupported hook site {site!r} "
+                "(resid_pre/resid_post/attn_out/mlp_out)"
+            )
+        max_layer = cfg.n_layers if code == _SITE_RESID else cfg.n_layers - 1
+        if not 0 <= layer <= max_layer:
             raise ValueError(f"hook layer {layer} out of range for {cfg.n_layers}-layer model")
-        layers.append(layer)
-    return tuple(layers)
+        pairs.append((layer, code))
+    return tuple(pairs)
+
+
+def _scan_stop(pairs: tuple[tuple[int, int], ...]) -> int:
+    """Layers that must run for every capture/edit to be observable: a
+    resid slot at L needs blocks [0, L); a sublayer slot at L needs block L
+    itself."""
+    return max(
+        (layer + (1 if code != _SITE_RESID else 0) for layer, code in pairs),
+        default=0,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -354,7 +398,7 @@ def _forward_impl(
     params: LMParams,
     tokens: jax.Array,
     cfg: LMConfig,
-    capture: tuple[int, ...],
+    capture: tuple[tuple[int, int], ...],
     edit_fns: tuple[Callable, ...],
     edit_layers: tuple[int, ...],
     edit_values: tuple[jax.Array, ...],
@@ -370,7 +414,11 @@ def _forward_impl(
     resid = params["embed"][tokens].astype(dt) * jnp.asarray(math.sqrt(D), dt)
 
     n_cap = len(capture)
-    cap_arr = jnp.asarray(capture, dtype=jnp.int32) if n_cap else None
+    cap_arr = jnp.asarray([l for l, _ in capture], dtype=jnp.int32) if n_cap else None
+    cap_sites = jnp.asarray([c for _, c in capture], dtype=jnp.int32) if n_cap else None
+    # static: skip the sublayer-capture FMAs entirely on resid-only runs
+    want_attn = any(c == _SITE_ATTN for _, c in capture)
+    want_mlp = any(c == _SITE_MLP for _, c in capture)
     cap_buf = jnp.zeros((n_cap, B, S, D), dtype=dt) if n_cap else None
     edit_arr = jnp.asarray(edit_layers, dtype=jnp.int32) if edit_layers else None
 
@@ -391,16 +439,20 @@ def _forward_impl(
         resid, buf = carry
         lp, i = xs
         resid = apply_hooks(resid, i)
-        buf = _capture_into(buf, resid, i, cap_arr)
+        buf = _capture_into(buf, resid, i, cap_arr, _SITE_RESID, cap_sites)
         is_local = (i % 2) == 0                             # even layers: sliding window
-        resid = _block(resid, lp, cfg, is_local)
+        resid, attn_out, mlp_out = _block(resid, lp, cfg, is_local)
+        if want_attn:
+            buf = _capture_into(buf, attn_out, i, cap_arr, _SITE_ATTN, cap_sites)
+        if want_mlp:
+            buf = _capture_into(buf, mlp_out, i, cap_arr, _SITE_MLP, cap_sites)
         return (resid, buf), None
 
     (resid, cap_buf), _ = jax.lax.scan(body, (resid, cap_buf), (stacked, layer_ids))
     # virtual layer n_scan: resid_pre of the first unscanned block (== final
     # resid_post when n_scan == n_layers)
     resid = apply_hooks(resid, jnp.int32(n_scan))
-    cap_buf = _capture_into(cap_buf, resid, jnp.int32(n_scan), cap_arr)
+    cap_buf = _capture_into(cap_buf, resid, jnp.int32(n_scan), cap_arr, _SITE_RESID, cap_sites)
 
     logits = _unembed(params, resid, cfg) if return_logits else None
     return logits, cap_buf
@@ -425,8 +477,14 @@ def forward(
     - ``return_logits=False`` skips the unembedding (the d_model→256k matmul
       dominates harvest FLOPs above the hook layer; harvesting never needs it).
     """
-    cap_layers = _hook_layers(cfg, capture)
-    edit_layers = _hook_layers(cfg, [e.hook_point for e in edits])
+    cap_pairs = _hook_layers(cfg, capture)
+    edit_pairs = _hook_layers(cfg, [e.hook_point for e in edits])
+    if any(code != _SITE_RESID for _, code in edit_pairs):
+        raise ValueError(
+            "activation edits support residual-stream sites only "
+            "(resid_pre/resid_post); attn_out/mlp_out are capture-only"
+        )
+    edit_layers = tuple(layer for layer, _ in edit_pairs)
     edit_fns = tuple(e.fn for e in edits)
     zeros = None
     values = []
@@ -441,10 +499,10 @@ def forward(
     n_scan = (
         cfg.n_layers
         if return_logits
-        else min(cfg.n_layers, max(cap_layers + edit_layers, default=0))
+        else min(cfg.n_layers, max(_scan_stop(cap_pairs), _scan_stop(edit_pairs)))
     )
     logits, cap_buf = _forward_impl(
-        params, tokens, cfg, cap_layers, edit_fns, edit_layers, tuple(values),
+        params, tokens, cfg, cap_pairs, edit_fns, edit_layers, tuple(values),
         return_logits, n_scan=n_scan,
     )
     cache = {hp: cap_buf[i] for i, hp in enumerate(capture)}
@@ -604,7 +662,7 @@ def _check_seq_divisible(tokens: jax.Array, mesh, axis_name: str) -> None:
 
 def _seq_local_body(
     params, tok_local, cfg: LMConfig, axis_name: str, n: int,
-    cap_layers: tuple[int, ...], return_logits: bool,
+    cap_layers: tuple[tuple[int, int], ...], return_logits: bool,
 ):
     """Per-shard forward over the local sequence slice (shared by the
     single-model and fused multi-model sequence-parallel entry points).
@@ -620,11 +678,14 @@ def _seq_local_body(
     n_cap = len(cap_layers)
     scale = cfg.query_pre_attn_scalar ** -0.5
     n_scan = cfg.n_layers if return_logits else min(
-        cfg.n_layers, max(cap_layers, default=0)
+        cfg.n_layers, _scan_stop(cap_layers)
     )
 
     B, Sl = tok_local.shape
-    cap_arr = jnp.asarray(cap_layers, jnp.int32) if n_cap else None
+    cap_arr = jnp.asarray([l for l, _ in cap_layers], jnp.int32) if n_cap else None
+    cap_sites = jnp.asarray([c for _, c in cap_layers], jnp.int32) if n_cap else None
+    want_attn = any(c == _SITE_ATTN for _, c in cap_layers)
+    want_mlp = any(c == _SITE_MLP for _, c in cap_layers)
     idx = jax.lax.axis_index(axis_name)
     pos = idx * Sl + jnp.arange(Sl)
     resid = params["embed"][tok_local].astype(dt) * jnp.asarray(
@@ -635,7 +696,7 @@ def _seq_local_body(
     def body(carry, xs):
         resid, buf = carry
         lp, i = xs
-        buf = _capture_into(buf, resid, i, cap_arr)
+        buf = _capture_into(buf, resid, i, cap_arr, _SITE_RESID, cap_sites)
         is_local = (i % 2) == 0
         xn = _rms_norm(resid, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _qkv(xn, lp, cfg, pos)
@@ -647,22 +708,28 @@ def _seq_local_body(
         a = jnp.einsum(
             "bsq,qd->bsd", a, lp["wo"], preferred_element_type=jnp.float32
         ).astype(dt)
-        resid = resid + _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+        attn_out = _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+        if want_attn:
+            buf = _capture_into(buf, attn_out, i, cap_arr, _SITE_ATTN, cap_sites)
+        resid = resid + attn_out
         mlp = _mlp(_rms_norm(resid, lp["pre_ffw_norm"], cfg.rms_eps), lp)
-        resid = resid + _rms_norm(mlp, lp["post_ffw_norm"], cfg.rms_eps)
+        mlp_out = _rms_norm(mlp, lp["post_ffw_norm"], cfg.rms_eps)
+        if want_mlp:
+            buf = _capture_into(buf, mlp_out, i, cap_arr, _SITE_MLP, cap_sites)
+        resid = resid + mlp_out
         return (resid, buf), None
 
     stacked = jax.tree_util.tree_map(lambda x: x[:n_scan], params["layers"])
     layer_ids = jnp.arange(n_scan, dtype=jnp.int32)
     (resid, buf), _ = jax.lax.scan(body, (resid, buf), (stacked, layer_ids))
-    buf = _capture_into(buf, resid, jnp.int32(n_scan), cap_arr)
+    buf = _capture_into(buf, resid, jnp.int32(n_scan), cap_arr, _SITE_RESID, cap_sites)
     logits = _unembed(params, resid, cfg) if return_logits else None
     return logits, buf
 
 
 @functools.lru_cache(maxsize=32)
 def _seq_parallel_fn(
-    cfg: LMConfig, mesh, axis_name: str, cap_layers: tuple[int, ...], return_logits: bool
+    cfg: LMConfig, mesh, axis_name: str, cap_layers: tuple[tuple[int, int], ...], return_logits: bool
 ):
     """Compile-once builder for the sequence-parallel forward (keyed on
     everything that changes the traced program; token/batch shapes go
@@ -691,7 +758,7 @@ def _seq_parallel_fn(
 
 @functools.lru_cache(maxsize=32)
 def _seq_parallel_multi_fn(
-    cfg: LMConfig, mesh, axis_name: str, cap_layers: tuple[int, ...]
+    cfg: LMConfig, mesh, axis_name: str, cap_layers: tuple[tuple[int, int], ...]
 ):
     """Fused multi-model sequence-parallel capture: ONE jitted shard_map
     dispatch runs every model's truncated forward over the same local token

@@ -37,6 +37,8 @@ _PARAM_SPECS: dict[str, P] = {
     "b_enc": P("model"),
     "b_dec": P(None, None),
     "log_theta": P("model"),
+    # AuxK dead-latent tracker (TrainState.aux): latent-axis, like b_enc
+    "steps_since_fired": P("model"),
 }
 
 # EP-style alternative (cfg.shard_sources, component N4 as a sharding mode):
@@ -53,6 +55,7 @@ _SOURCE_SPECS: dict[str, P] = {
     "b_enc": P(None),              # latent-axis params replicate in this mode
     "b_dec": P("model", None),
     "log_theta": P(None),
+    "steps_since_fired": P(None),
 }
 
 BATCH_SPEC = P("data", None, None)

@@ -23,6 +23,11 @@ class TrainState(NamedTuple):
     params: dict[str, jax.Array]
     opt_state: Any
     step: jax.Array  # int32 scalar
+    # non-optimizer training state. AuxK (cfg.aux_k > 0) tracks
+    # ``steps_since_fired`` [d_hidden] int32 here; None (an empty pytree
+    # node) otherwise, so checkpoints of aux-free configs keep their exact
+    # leaf set and old saves restore unchanged.
+    aux: Any = None
 
 
 def make_optimizer(cfg: CrossCoderConfig, lr_fn) -> optax.GradientTransformation:
@@ -43,4 +48,12 @@ def init_train_state(key: jax.Array, cfg: CrossCoderConfig, tx: optax.GradientTr
     # cfg.enc_dtype for MXU compute either way
     dtype = jnp.float32 if cfg.master_dtype == "fp32" else jnp.bfloat16
     params = cc.init_params(key, cfg, dtype=dtype)
-    return TrainState(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+    aux = None
+    if cfg.aux_k > 0:
+        # every latent starts "recently fired": nothing is dead until it
+        # has failed to fire for aux_dead_steps real steps
+        aux = {"steps_since_fired": jnp.zeros((cfg.dict_size,), jnp.int32)}
+    return TrainState(
+        params=params, opt_state=tx.init(params),
+        step=jnp.zeros((), jnp.int32), aux=aux,
+    )

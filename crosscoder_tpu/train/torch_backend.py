@@ -48,6 +48,11 @@ class TorchTrainer:
                 f"torch backend implements the reference's dense-ReLU step only; "
                 f"activation={cfg.activation!r} must use the jax backend"
             )
+        if cfg.aux_k > 0:
+            raise NotImplementedError(
+                "torch backend has no AuxK dead-latent loss (a TPU-native "
+                "extension); aux_k > 0 must use the jax backend"
+            )
         self.torch = torch
         self.cfg = cfg
         self.device = device

@@ -79,15 +79,22 @@ def make_train_step(
         x = batch.astype(jnp.float32) * scale[None, :, None]
         l1_coeff = l1_fn(state.step)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        kwargs: dict[str, Any] = {}
         if cfg.l0_coeff > 0:
             # L0 warms up over the same window as L1 (reference
             # trainer.py:34-39's ramp, applied to both sparsity terms)
-            (loss, losses), grads = grad_fn(
-                state.params, x, l1_coeff,
-                l0_coeff=cfg.l0_coeff * warm_fn(state.step),
-            )
-        else:
-            (loss, losses), grads = grad_fn(state.params, x, l1_coeff)
+            kwargs["l0_coeff"] = cfg.l0_coeff * warm_fn(state.step)
+        dead = None
+        if cfg.aux_k > 0:
+            # AuxK (dead-latent revival): latents quiet for aux_dead_steps
+            # are "dead"; the aux loss reconstructs the step's residual
+            # with the top aux_k of them. Same warmup ramp as the other
+            # sparsity terms (and naturally inert for the first
+            # aux_dead_steps — nothing can be dead yet).
+            dead = state.aux["steps_since_fired"] >= cfg.aux_dead_steps
+            kwargs["dead_mask"] = dead
+            kwargs["aux_coeff"] = cfg.aux_k_coeff * warm_fn(state.step)
+        (loss, losses), grads = grad_fn(state.params, x, l1_coeff, **kwargs)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
@@ -97,6 +104,15 @@ def make_train_step(
             "l1_coeff": l1_coeff,
             "lr": lr_fn(state.step),
         }
+        new_aux = state.aux
+        if cfg.aux_k > 0:
+            new_aux = {
+                "steps_since_fired": jnp.where(
+                    losses.fired, 0, state.aux["steps_since_fired"] + 1
+                )
+            }
+            metrics["dead_frac"] = jnp.mean(dead.astype(jnp.float32))
+            metrics["aux_loss"] = losses.aux_loss
         if with_metrics:
             metrics["l0_loss"] = losses.l0_loss
             metrics["explained_variance"] = jnp.mean(losses.explained_variance)
@@ -104,7 +120,7 @@ def make_train_step(
             metrics["explained_variance_per_source"] = jnp.mean(
                 losses.explained_variance_per_source, axis=-1
             )
-        new_state = TrainState(new_params, new_opt, state.step + 1)
+        new_state = TrainState(new_params, new_opt, state.step + 1, new_aux)
         return new_state, metrics
 
     batch_sh = mesh_lib.batch_sharding(mesh)
@@ -315,6 +331,9 @@ class Trainer:
             self._prefetch_pool.shutdown(wait=True)
             self._prefetch_pool = None
             self._pending = None
+        if self.checkpointer is not None and hasattr(self.checkpointer, "wait"):
+            # land any background checkpoint write before process exit
+            self.checkpointer.wait()
 
     def step(self, full_metrics: bool = True) -> dict[str, jax.Array]:
         """One optimizer step; returns device-resident metrics (no sync).
@@ -342,10 +361,61 @@ class Trainer:
         if self.logger is not None:
             self.logger.log(expand_metrics(metrics, self.cfg.n_sources), step)
 
-    def save(self) -> None:
-        # ALL processes enter: the state fetch inside Checkpointer.save is
-        # a collective on a multi-host mesh (process_allgather of
-        # non-addressable leaves); only process 0 writes files
+    def _final_save_agreed(self, clean: bool) -> bool:
+        """All-processes-clean agreement for the final collective save,
+        WITHOUT risking an indefinite hang.
+
+        A process that failed must never enter an unbounded collective:
+        parking it in an allgather keeps it alive, masks the failure from
+        the distributed runtime's heartbeat, and hangs every healthy
+        host's next collective forever. So: local failure → return False
+        immediately (fast-fail, the runtime's failure detection unblocks
+        the others). Clean processes agree through the coordination
+        service's host-level barrier, which is TIMEOUT-BOUNDED — if any
+        peer died or skipped the barrier, the wait expires and the
+        healthy hosts skip the save instead of deadlocking in it.
+        """
+        if not clean:
+            return False
+        # import/lookup OUTSIDE the try: jax._src is a private namespace,
+        # and an ImportError after a jax upgrade must fail loudly here, not
+        # masquerade as a barrier timeout that silently skips every final
+        # multi-host checkpoint
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            # no coordination client on a multi-process mesh (should not
+            # happen — multihost.initialize creates one): any agreement
+            # collective here would be UNBOUNDED and recreate the pod
+            # deadlock this function exists to prevent; skip the save
+            print("[crosscoder_tpu] no coordination-service client: "
+                  "skipping the final collective save (periodic saves "
+                  "already landed)", flush=True)
+            return False
+        try:
+            # same id on every process at a clean exit (same step);
+            # step-suffixed so a retried/looped train() reuses nothing
+            client.wait_at_barrier(
+                f"crosscoder_tpu_final_save_{int(self.state.step)}",
+                timeout_in_ms=60_000,
+            )
+            return True
+        except Exception as e:  # timeout or a peer died mid-barrier
+            print(f"[crosscoder_tpu] final-save barrier not reached by all "
+                  f"processes ({e}); skipping the collective save", flush=True)
+            return False
+
+    def save(self, background: bool = False) -> None:
+        """Checkpoint now. ``background=True`` (the train loop's periodic
+        saves) returns after the device→host fetch and streams the file
+        write concurrently with subsequent steps; callers that need the
+        files on disk when this returns (tests, scripts) use the default.
+
+        ALL processes enter: the state fetch inside Checkpointer.save is
+        a collective on a multi-host mesh (process_allgather of
+        non-addressable leaves); only process 0 writes files.
+        """
         if self.checkpointer is not None:
             # quiesce the prefetch worker (no mid-next() device contention),
             # then checkpoint the PRE-prefetch stream snapshot so resume
@@ -355,7 +425,9 @@ class Trainer:
             if self._pending is not None and self._buffer_snapshot is not None:
                 snap = self._buffer_snapshot
                 buffer = types.SimpleNamespace(state_dict=lambda: snap)
-            self.checkpointer.save(self.state, self.cfg, buffer=buffer)
+            self.checkpointer.save(
+                self.state, self.cfg, buffer=buffer, background=background
+            )
 
     def train(self, num_steps: int | None = None) -> dict[str, float]:
         """Run the training loop (reference ``trainer.py:72-82`` semantics:
@@ -399,15 +471,21 @@ class Trainer:
                   "writing checkpoint", flush=True)
 
         multi_process = jax.process_count() > 1
+        poll_every = max(1, int(self.cfg.stop_poll_every))
 
-        def _stop_agreed() -> bool:
+        def _stop_agreed(i: int) -> bool:
             # Checkpointer.save is a COLLECTIVE on a multi-host mesh, so the
             # decision to stop-and-save must be agreed by every process — a
             # SIGTERM (preemption notice) often reaches only one host. A
-            # tiny allgathered flag makes the stop point SPMD-consistent;
-            # single-process runs skip the sync entirely.
+            # tiny allgathered flag makes the stop point SPMD-consistent.
+            # The allgather is a host-blocking cross-host collective, so it
+            # runs only every ``cfg.stop_poll_every`` steps (same step on
+            # every process → still SPMD-consistent); single-process runs
+            # skip the sync entirely.
             if not multi_process:
                 return stop_requested
+            if i % poll_every != 0:
+                return False
             import numpy as _np
 
             from jax.experimental import multihost_utils
@@ -421,7 +499,7 @@ class Trainer:
         clean = False
         try:
             for i in progress:
-                if _stop_agreed():
+                if _stop_agreed(i):
                     break
                 if self.cfg.profile_dir and i == start + 10:
                     jax.profiler.start_trace(self.cfg.profile_dir)
@@ -441,22 +519,29 @@ class Trainer:
                     last_log_t, last_log_i = now, i
                     self.log(metrics, step=i)
                 if (i + 1) % self.cfg.save_every == 0:
-                    self.save()
+                    # background: the file write overlaps subsequent steps;
+                    # only the device→host fetch blocks the loop
+                    self.save(background=True)
             clean = True
         finally:
             if in_main_thread:
                 signal.signal(signal.SIGTERM, prev_handler or signal.SIG_DFL)
             if profiling:
                 jax.profiler.stop_trace()
-            if clean or not multi_process:
-                # clean exits are SPMD-consistent (same step on every
-                # process), so the collective save is safe; a process-LOCAL
-                # exception on a multi-host mesh is not — entering a
-                # collective there would hang every healthy host, so skip
-                # the final save rather than deadlock the pod
+            if not multi_process:
+                # background + the close() below joining the writer: on
+                # SIGTERM the fetch and the write both still land before
+                # exit, but a mid-write kill can no longer tear the save
+                self.save(background=True)
+            elif self._final_save_agreed(clean):
+                # every process reached this point cleanly (same step on
+                # every process — SPMD-consistent), so the collective save
+                # is safe; without the agreement, a process-LOCAL exception
+                # would leave the OTHER hosts entering the collective save
+                # and deadlocking the pod
                 self.save()
             else:
-                print("[crosscoder_tpu] exception on a multi-process mesh: "
+                print("[crosscoder_tpu] not all processes exited cleanly: "
                       "skipping the final (collective) checkpoint to avoid "
                       "a cross-host deadlock", flush=True)
             self.close()

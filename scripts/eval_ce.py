@@ -45,6 +45,17 @@ PUBLISHED_FACTORS = (0.2758961493232058, 0.24422852496546169)
 # published CE-recovered values (nb:cell 30, BASELINE.md)
 PUBLISHED_RECOVERED = {"A": 0.921875, "B": 0.92578125}
 
+# Expected DEMO values, recorded from the committed default-steps run
+# (artifacts/ce_gate_demo.json; deterministic seeds — residual spread is
+# platform numerics). At the default step counts the gate now checks a
+# tight band around these, not just the smoke thresholds: the old
+# recovered>0.6 floor would have passed a mediocre crosscoder (round-3
+# VERDICT weak #1), while ±0.05 around ≈0.99 only passes one that
+# actually reconstructs the demo pair's streams.
+DEMO_EXPECTED_RECOVERED = {"A": 1.0076, "B": 0.9864}
+DEMO_BAND = 0.05
+DEMO_DEFAULT_STEPS = (400, 1500)  # (--demo-lm-steps, --demo-cc-steps)
+
 
 def _load_tokens(path: str, n_seqs: int | None) -> np.ndarray:
     if path.endswith(".pt"):
@@ -188,6 +199,28 @@ def run_demo(args) -> dict:
         and out["ce_zero_abl_A"] - out["ce_clean_A"] > 0.5
         and out["ce_zero_abl_B"] - out["ce_clean_B"] > 0.5
     )
+    # demo-specific expected bands (only meaningful at the default step
+    # counts the expectations were recorded at; a custom-steps run keeps
+    # the smoke gate and reports distance as informational)
+    at_defaults = (args.demo_lm_steps, args.demo_cc_steps) == DEMO_DEFAULT_STEPS
+    out["expected_recovered"] = DEMO_EXPECTED_RECOVERED
+    out["distance_from_expected"] = {
+        m: abs(out[f"ce_recovered_{m}"] - DEMO_EXPECTED_RECOVERED[m])
+        for m in ("A", "B")
+    }
+    out["expected_band"] = DEMO_BAND
+    out["band_checked"] = at_defaults
+    if at_defaults:
+        ok = (
+            ok
+            and out["distance_from_expected"]["A"] <= DEMO_BAND
+            and out["distance_from_expected"]["B"] <= DEMO_BAND
+            # the demo's zero floor sits WELL below zero (recorded −0.82 /
+            # −0.52); a floor creeping toward the trained value would make
+            # "recovered" vacuous long before the old <0.5 cap noticed
+            and out["oracle_zero_recovered"]["A"] < 0.0
+            and out["oracle_zero_recovered"]["B"] < 0.0
+        )
     out["gate_pass"] = bool(ok)
     return out
 

@@ -162,6 +162,11 @@ def test_eval_ce_script_demo_smoke(tmp_path):
             assert np.isfinite(m[f"{k}_{tag}"])
     assert abs(m["oracle_identity_recovered"]["A"] - 1) < 1e-3
     assert "gate_pass" in m
+    # tiny budgets are NOT the recorded-expectation run: the demo band is
+    # reported as informational but must not gate here
+    assert m["band_checked"] is False
+    assert set(m["distance_from_expected"]) == {"A", "B"}
+    assert m["expected_recovered"] == {"A": 1.0076, "B": 0.9864}
 
 
 def test_replicate_script_demo_smoke(tmp_path):

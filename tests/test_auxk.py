@@ -1,0 +1,212 @@
+"""AuxK dead-latent mitigation (cfg.aux_k — the standard TopK-SAE recipe,
+Gao et al. 2024; no reference counterpart, the reference's dense ReLU never
+faces mass latent death).
+
+Oracle strategy (SURVEY.md §4): an independent numpy re-statement of the
+aux-loss math, fed identical inputs, asserted against the jitted path in
+fp32; plus behavioral tests — fired-tracking semantics, the no-dead-latents
+noninterference guarantee, the gradient path to dead latents that the main
+TopK objective cannot provide, checkpoint round-trip of the tracker, and
+the sharded step on an 8-device mesh.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.train.trainer import Trainer
+from crosscoder_tpu.train import schedules
+from crosscoder_tpu.train.state import init_train_state, make_optimizer
+
+
+def _cfg(**kw):
+    base = dict(
+        d_in=16, dict_size=64, n_models=2, batch_size=32,
+        num_tokens=32 * 1000, enc_dtype="fp32", log_backend="null",
+        aux_k=8, aux_dead_steps=3, l1_coeff=0.0,
+    )
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+def _numpy_aux_loss(params, x, dead_mask, k_aux):
+    """Independent fp32 oracle of the AuxK loss: residual of the MAIN
+    reconstruction, reconstructed by the top-k_aux raw pre-acts among dead
+    latents through W_dec (no b_dec), normalized by the residual's power."""
+    w_enc = np.asarray(params["W_enc"], np.float32)
+    w_dec = np.asarray(params["W_dec"], np.float32)
+    b_enc = np.asarray(params["b_enc"], np.float32)
+    b_dec = np.asarray(params["b_dec"], np.float32)
+    h = np.einsum("bnd,ndh->bh", x, w_enc) + b_enc
+    f = np.maximum(h, 0.0)
+    recon = np.einsum("bh,hnd->bnd", f, w_dec) + b_dec
+    e = x - recon
+    masked = np.where(dead_mask[None, :], h, -np.inf)
+    order = np.argsort(-masked, axis=-1, kind="stable")[:, :k_aux]
+    vals = np.take_along_axis(masked, order, axis=-1)
+    vals = np.where(np.isfinite(vals), vals, 0.0)
+    e_hat = np.einsum("bk,bknd->bnd", vals, w_dec[order])
+    num = np.mean(np.sum((e_hat - e) ** 2, axis=(-2, -1)))
+    den = np.mean(np.sum(e ** 2, axis=(-2, -1)))
+    if not dead_mask.any():
+        return 0.0
+    return num / (den + 1e-8)
+
+
+def test_aux_loss_matches_numpy_oracle():
+    cfg = _cfg(activation="relu")
+    rng = np.random.default_rng(0)
+    params = cc.init_params(jax.random.key(1), cfg, dtype=jnp.float32)
+    x = rng.standard_normal((cfg.batch_size, cfg.n_sources, cfg.d_in)).astype(np.float32)
+    dead = np.zeros(cfg.dict_size, bool)
+    dead[::5] = True                      # 13 dead > aux_k=8: real top-k path
+    losses = cc.get_losses(params, jnp.asarray(x), cfg, dead_mask=jnp.asarray(dead))
+    want = _numpy_aux_loss(params, x, dead, cfg.aux_k)
+    np.testing.assert_allclose(float(losses.aux_loss), want, rtol=1e-5)
+
+
+def test_aux_loss_fewer_dead_than_aux_k():
+    # -inf padding rows must contribute exactly nothing
+    cfg = _cfg(activation="relu")
+    rng = np.random.default_rng(2)
+    params = cc.init_params(jax.random.key(3), cfg, dtype=jnp.float32)
+    x = rng.standard_normal((cfg.batch_size, cfg.n_sources, cfg.d_in)).astype(np.float32)
+    dead = np.zeros(cfg.dict_size, bool)
+    dead[[4, 17]] = True                  # 2 dead < aux_k=8
+    losses = cc.get_losses(params, jnp.asarray(x), cfg, dead_mask=jnp.asarray(dead))
+    want = _numpy_aux_loss(params, x, dead, cfg.aux_k)
+    np.testing.assert_allclose(float(losses.aux_loss), want, rtol=1e-5)
+
+
+def test_aux_loss_zero_when_nothing_dead():
+    cfg = _cfg(activation="relu")
+    rng = np.random.default_rng(4)
+    params = cc.init_params(jax.random.key(5), cfg, dtype=jnp.float32)
+    x = rng.standard_normal((cfg.batch_size, cfg.n_sources, cfg.d_in)).astype(np.float32)
+    dead = np.zeros(cfg.dict_size, bool)
+    losses = cc.get_losses(params, jnp.asarray(x), cfg, dead_mask=jnp.asarray(dead))
+    assert float(losses.aux_loss) == 0.0
+
+
+@pytest.mark.parametrize("activation,sparse", [
+    ("relu", False), ("topk", False), ("topk", True), ("batchtopk", False),
+])
+def test_fired_matches_dense_activity(activation, sparse):
+    cfg = _cfg(activation=activation, sparse_decode=sparse, topk_k=4)
+    rng = np.random.default_rng(6)
+    params = cc.init_params(jax.random.key(7), cfg, dtype=jnp.float32)
+    x = jnp.asarray(
+        rng.standard_normal((cfg.batch_size, cfg.n_sources, cfg.d_in)), jnp.float32
+    )
+    dead = jnp.zeros(cfg.dict_size, bool)
+    losses = cc.get_losses(params, x, cfg, dead_mask=dead)
+    f = cc.encode(params, x, cfg)
+    want = np.asarray(jnp.any(f > 0, axis=0))
+    np.testing.assert_array_equal(np.asarray(losses.fired), want)
+
+
+def test_no_dead_latents_means_identical_training():
+    # aux_dead_steps larger than the run: the aux term must never engage and
+    # the trajectory must equal the aux-free config's exactly
+    cfg_off = _cfg(activation="topk", topk_k=4, aux_k=0)
+    cfg_on = _cfg(activation="topk", topk_k=4, aux_k=8, aux_dead_steps=10**6)
+    losses = {}
+    for name, cfg in (("off", cfg_off), ("on", cfg_on)):
+        tr = Trainer(cfg)
+        vals = []
+        for _ in range(4):
+            vals.append(float(jax.device_get(tr.step()["loss"])))
+        tr.close()
+        losses[name] = vals
+    np.testing.assert_allclose(losses["on"], losses["off"], rtol=1e-6)
+
+
+def test_aux_gives_dead_latent_a_gradient_path():
+    # a latent TopK never selects gets NO gradient from the main objective;
+    # with it marked dead, the aux loss must deliver one to its encoder row
+    cfg = _cfg(activation="topk", topk_k=2)
+    params = cc.init_params(jax.random.key(11), cfg, dtype=jnp.float32)
+    # bury latent 0: huge negative encoder bias → never in the top-k
+    params["b_enc"] = params["b_enc"].at[0].set(-100.0)
+    rng = np.random.default_rng(12)
+    x = jnp.asarray(
+        rng.standard_normal((cfg.batch_size, cfg.n_sources, cfg.d_in)), jnp.float32
+    )
+
+    def loss_with(dead_mask):
+        def f(p):
+            loss, _ = cc.training_loss(p, x, 0.0, cfg, dead_mask=dead_mask)
+            return loss
+        return jax.grad(f)(params)
+
+    grads_free = loss_with(None)
+    g0_free = float(jnp.abs(grads_free["W_enc"][..., 0]).max())
+    assert g0_free == 0.0, "buried latent should get no main-objective grad"
+
+    dead = jnp.zeros(cfg.dict_size, bool).at[0].set(True)
+    grads_aux = loss_with(dead)
+    g0_aux = float(jnp.abs(grads_aux["W_enc"][..., 0]).max())
+    assert g0_aux > 0.0, "aux loss must give the dead latent a gradient"
+
+
+def test_trainer_tracks_steps_since_fired():
+    cfg = _cfg(activation="topk", topk_k=4, aux_dead_steps=2)
+    tr = Trainer(cfg)
+    assert tr.state.aux is not None
+    m = tr.step()
+    since = np.asarray(jax.device_get(tr.state.aux["steps_since_fired"]))
+    # after one step: fired latents at 0, silent ones at 1
+    assert set(np.unique(since)).issubset({0, 1})
+    assert (since == 0).sum() >= cfg.topk_k  # at least the batch's top-k fired
+    for _ in range(4):
+        m = tr.step()
+    assert "dead_frac" in m and "aux_loss" in m
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+    tr.close()
+
+
+def test_checkpoint_roundtrips_aux_state(tmp_path):
+    from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+
+    cfg = _cfg(activation="topk", topk_k=4, checkpoint_dir=str(tmp_path))
+    tr = Trainer(cfg, checkpointer=Checkpointer(cfg=cfg))
+    for _ in range(3):
+        tr.step()
+    since_before = np.asarray(jax.device_get(tr.state.aux["steps_since_fired"]))
+    tr.save()
+    tr.close()
+
+    tr2 = Trainer(cfg, checkpointer=Checkpointer(cfg=cfg))
+    tr2.restore()
+    since_after = np.asarray(jax.device_get(tr2.state.aux["steps_since_fired"]))
+    np.testing.assert_array_equal(since_after, since_before)
+    assert tr2.step_counter == 3
+    tr2.close()
+
+
+def test_auxk_sharded_step_runs():
+    # 8-device mesh, TP over the dict axis: steps_since_fired shards with
+    # b_enc and the step stays finite
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+
+    cfg = _cfg(activation="topk", topk_k=4, batch_size=32,
+               data_axis_size=4, model_axis_size=2, aux_dead_steps=1)
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    tr = Trainer(cfg, mesh=mesh)
+    for _ in range(3):
+        m = tr.step()
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+    assert np.isfinite(float(jax.device_get(m["aux_loss"])))
+    since = tr.state.aux["steps_since_fired"]
+    assert since.shape == (cfg.dict_size,)
+    tr.close()
+
+
+def test_config_rejects_bad_aux_k():
+    with pytest.raises(ValueError):
+        _cfg(aux_k=-1)
+    with pytest.raises(ValueError):
+        _cfg(aux_k=10**9)

@@ -74,6 +74,82 @@ def test_resume_is_bit_exact(tmp_path):
         np.testing.assert_array_equal(params_straight[k], np.asarray(params_resumed[k]), err_msg=k)
 
 
+def test_background_save_lands_and_resumes(tmp_path):
+    """save(background=True): write overlaps training; wait()/restore see
+    the complete save; tmp files never linger (round-3 VERDICT weak #3 —
+    the synchronous full-state write sat inside the preemption window)."""
+    cfg = tiny_cfg(tmp_path)
+    ck = Checkpointer(cfg=cfg)
+    tr = Trainer(cfg, checkpointer=ck)
+    for _ in range(3):
+        tr.step()
+    tr.save(background=True)
+    for _ in range(2):
+        tr.step()                      # steps proceed while the write runs
+    ck.wait()
+    vdir = tmp_path / "version_0"
+    assert (vdir / "0.npz").exists() and (vdir / "0_meta.json").exists()
+    assert not list(vdir.glob("*.tmp"))
+    assert json.loads((vdir / "0_meta.json").read_text())["step"] == 3
+
+    # restore() on the same instance self-serializes (no explicit wait)
+    tr.save(background=True)
+    tr2 = Trainer(cfg, checkpointer=ck)
+    meta = tr2.restore()
+    assert meta["step"] == 5
+    tr.close()
+    tr2.close()
+
+
+def test_background_saves_serialize(tmp_path):
+    """back-to-back background saves: versions appear in order, none torn."""
+    cfg = tiny_cfg(tmp_path)
+    ck = Checkpointer(cfg=cfg)
+    tr = Trainer(cfg, checkpointer=ck)
+    for i in range(3):
+        tr.step()
+        tr.save(background=True)
+    tr.close()                         # joins the writer
+    vdir = tmp_path / "version_0"
+    for v in range(3):
+        assert (vdir / f"{v}.npz").exists(), v
+        assert json.loads((vdir / f"{v}_meta.json").read_text())["step"] == v + 1
+    assert not list(vdir.glob("*.tmp"))
+
+
+def test_torn_save_is_skipped(tmp_path):
+    """A save whose meta (the completion marker, written last) is missing —
+    a kill after the weights npz landed — must be invisible: restore picks
+    the previous COMPLETE save instead of crashing on missing files."""
+    cfg = tiny_cfg(tmp_path)
+    ck = Checkpointer(cfg=cfg)
+    tr = Trainer(cfg, checkpointer=ck)
+    tr.step()
+    tr.save()
+    vdir = tmp_path / "version_0"
+    # simulate the torn save: weights of save 1 present, no meta/state
+    (vdir / "1.npz").write_bytes((vdir / "0.npz").read_bytes())
+    assert Checkpointer.latest_save(vdir) == 0
+    tr2 = Trainer(cfg, checkpointer=Checkpointer(base_dir=tmp_path))
+    assert tr2.restore()["step"] == 1
+    tr.close()
+    tr2.close()
+
+    # a FRESH run preempted during its very first save: version_1 holds
+    # only torn artifacts (even train_state, killed before meta) — resume
+    # must fall back to version_0's complete save, not crash on version_1
+    v1 = tmp_path / "version_1"
+    v1.mkdir()
+    (v1 / "0.npz").write_bytes((vdir / "0.npz").read_bytes())
+    (v1 / "0_train_state.npz").write_bytes((vdir / "0_train_state.npz").read_bytes())
+    tr3 = Trainer(cfg, checkpointer=Checkpointer(base_dir=tmp_path))
+    assert tr3.restore()["step"] == 1
+    tr3.close()
+    import pytest
+    with pytest.raises(FileNotFoundError):
+        Checkpointer.latest_save(v1)  # torn, not a foreign weights-only dir
+
+
 def test_restore_rejects_mismatched_shapes(tmp_path):
     cfg = tiny_cfg(tmp_path)
     tr = Trainer(cfg, checkpointer=Checkpointer(cfg=cfg))

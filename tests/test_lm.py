@@ -91,6 +91,77 @@ def test_resid_pre_capture_parity(tiny_pair, tokens):
     )
 
 
+def test_attn_mlp_out_capture_parity(tiny_pair, tokens):
+    """hook_attn_out / hook_mlp_out (round-3 VERDICT missing #4: only resid
+    sites parsed) must equal the HF sublayer contributions: Gemma-2 adds
+    post_attention_layernorm(attn) and post_feedforward_layernorm(mlp) to
+    the stream, so torch module hooks on those norms capture exactly our
+    definition."""
+    model, params, cfg = tiny_pair
+    got_hf = {}
+
+    def grab(name):
+        def hook(mod, inp, out):
+            got_hf[name] = out.detach().numpy()
+        return hook
+
+    handles = []
+    for L in (0, 2):
+        layer = model.model.layers[L]
+        handles.append(layer.post_attention_layernorm.register_forward_hook(
+            grab(f"attn{L}")))
+        handles.append(layer.post_feedforward_layernorm.register_forward_hook(
+            grab(f"mlp{L}")))
+    try:
+        _hf_forward(model, tokens)
+    finally:
+        for h in handles:
+            h.remove()
+
+    hooks = [f"blocks.{L}.hook_{site}" for L in (0, 2)
+             for site in ("attn_out", "mlp_out")]
+    cache = lm.run_with_cache(params, jnp.asarray(tokens), cfg, hooks)
+    for L in (0, 2):
+        np.testing.assert_allclose(
+            np.asarray(cache[f"blocks.{L}.hook_attn_out"]), got_hf[f"attn{L}"],
+            rtol=2e-4, atol=2e-4, err_msg=f"attn_out L{L}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(cache[f"blocks.{L}.hook_mlp_out"]), got_hf[f"mlp{L}"],
+            rtol=2e-4, atol=2e-4, err_msg=f"mlp_out L{L}",
+        )
+
+
+def test_sublayer_hooks_sum_to_stream(tiny_pair, tokens):
+    """resid_post(L) == resid_pre(L) + attn_out(L) + mlp_out(L) exactly
+    (all four captured in one truncated forward; also proves the scan stops
+    at L+1 for sublayer sites, not L)."""
+    _, params, cfg = tiny_pair
+    L = cfg.n_layers - 1                   # last layer: the edge case
+    hooks = [f"blocks.{L}.hook_resid_pre", f"blocks.{L}.hook_attn_out",
+             f"blocks.{L}.hook_mlp_out", f"blocks.{L}.hook_resid_post"]
+    cache = lm.run_with_cache(params, jnp.asarray(tokens), cfg, hooks)
+    got = (np.asarray(cache[hooks[0]]) + np.asarray(cache[hooks[1]])
+           + np.asarray(cache[hooks[2]]))
+    np.testing.assert_allclose(
+        got, np.asarray(cache[hooks[3]]), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_sublayer_hook_validation(tiny_pair, tokens):
+    _, params, cfg = tiny_pair
+    tok = jnp.asarray(tokens)
+    # attn_out exists only for real layers (no virtual n_layers slot)
+    with pytest.raises(ValueError, match="out of range"):
+        lm.run_with_cache(params, tok, cfg, [f"blocks.{cfg.n_layers}.hook_attn_out"])
+    with pytest.raises(ValueError, match="unsupported hook site"):
+        lm.run_with_cache(params, tok, cfg, ["blocks.0.hook_z"])
+    # edits stay residual-only
+    with pytest.raises(ValueError, match="capture-only"):
+        lm.forward(params, tok, cfg,
+                   edits=[lm.Edit("blocks.0.hook_attn_out", lm.zero_edit)])
+
+
 def test_ce_loss_parity(tiny_pair, tokens):
     """Our mean next-token CE matches torch cross_entropy on HF logits
     (TransformerLens return_type='loss' semantics, nb:cell 29)."""
