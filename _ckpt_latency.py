@@ -66,6 +66,13 @@ def main(argv=None):
         tr.save(background=False)
         sync_s = time.perf_counter() - t0
 
+        # a step between the two saves: the donated update produces FRESH
+        # device arrays, so the background save's fetch cannot hit
+        # jax.Array's cached host copy from the save above (which would
+        # understate the blocking portion)
+        m = tr.step()
+        float(jax.device_get(m["loss"]))
+
         # (b) background save: blocking portion is the fetch
         t0 = time.perf_counter()
         tr.save(background=True)
@@ -88,7 +95,15 @@ def main(argv=None):
         print(json.dumps(results["runs"][-1]))
 
     runs = results["runs"][1:] or results["runs"]   # drop cold-cache run
-    med = lambda k: sorted(r[k] for r in runs)[len(runs) // 2]
+
+    def med(k):
+        vals = sorted(r[k] for r in runs)
+        n = len(vals)
+        # true median: even counts average the middle two (picking
+        # vals[n//2] alone would report the MAX of two kept runs)
+        m = vals[n // 2] if n % 2 else (vals[n // 2 - 1] + vals[n // 2]) / 2
+        return round(m, 3)
+
     results["median"] = {k: med(k) for k in runs[0]}
     results["overlap_win"] = round(
         results["median"]["sync_save_s"]

@@ -1,0 +1,101 @@
+"""Quantitative torch↔JAX trajectory parity (round-3 VERDICT next #6).
+
+The 38-step rig (tests/test_backends.py) crosses only the lr-decay
+boundary at toy shape. This runs BOTH engines for 2000 steps at dict 4096
+with IDENTICAL init (the jax init is copied into the torch tensors
+in-place, so divergence measures accumulated numerics drift, not sampler
+noise), identical synthetic data streams, crossing the L1-warmup boundary
+(step 100 at l1_warmup_frac=0.05) and the lr-decay start (step 1600), and
+records the max relative loss divergence as an artifact.
+
+Runs on CPU (torch has no TPU here; both engines in fp32):
+    python _traj_parity.py          # TP_STEPS=2000 default
+Writes artifacts/TRAJ_PARITY_r04.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import torch
+
+    from crosscoder_tpu.config import CrossCoderConfig
+    from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+    from crosscoder_tpu.train.torch_backend import make_trainer
+
+    steps = int(os.environ.get("TP_STEPS", 2000))
+    cfg = CrossCoderConfig(
+        d_in=32, dict_size=4096, batch_size=64, num_tokens=64 * steps,
+        lr=1e-3, l1_coeff=1.0, enc_dtype="fp32", log_backend="null", seed=11,
+    )
+    warmup_end = int(cfg.l1_warmup_frac * cfg.total_steps)
+    decay_start = int((1 - cfg.lr_decay_frac) * cfg.total_steps)
+
+    tj = make_trainer(cfg, "jax", buffer=SyntheticActivationSource(cfg))
+    tt = make_trainer(cfg, "torch", buffer=SyntheticActivationSource(cfg))
+    # identical init: jax's draw becomes the torch tensors' values in-place
+    # (the Adam optimizer already references these tensors)
+    jp = jax.device_get(tj.state.params)
+    with torch.no_grad():
+        for k, v in tt.params.items():
+            v.copy_(torch.from_numpy(np.asarray(jp[k], np.float32)))
+
+    lj, lt = [], []
+    t0 = time.perf_counter()
+    for i in range(steps):
+        mj = tj.step()
+        lj.append(float(jax.device_get(mj["loss"])))
+        lt.append(tt.step()["loss"])
+        if (i + 1) % 200 == 0:
+            print(f"step {i+1}: jax={lj[-1]:.5f} torch={lt[-1]:.5f} "
+                  f"rel={(lj[-1]-lt[-1])/lt[-1]:+.2e}", flush=True)
+    wall = time.perf_counter() - t0
+    tj.close()
+
+    a, b = np.asarray(lj), np.asarray(lt)
+    rel = np.abs(a - b) / np.maximum(np.abs(b), 1e-9)
+
+    def seg(lo, hi):
+        r = rel[lo:hi]
+        return {"max_rel": float(r.max()), "mean_rel": float(r.mean()),
+                "steps": [lo, hi]}
+
+    out = {
+        "steps": steps, "dict_size": cfg.dict_size, "d_in": cfg.d_in,
+        "batch_size": cfg.batch_size, "identical_init": True,
+        "l1_warmup_end_step": warmup_end, "lr_decay_start_step": decay_start,
+        "wall_s": round(wall, 1),
+        "max_rel_divergence": float(rel.max()),
+        "max_rel_divergence_after_step10": float(rel[10:].max()),
+        "segments": {
+            "warmup(0..{})".format(warmup_end): seg(0, warmup_end),
+            "plateau": seg(warmup_end, decay_start),
+            "decay": seg(decay_start, steps),
+        },
+        "final_loss": {"jax": float(a[-1]), "torch": float(b[-1])},
+        "curve_every_50": [
+            {"step": i, "jax": float(a[i]), "torch": float(b[i]),
+             "rel": float(rel[i])}
+            for i in range(0, steps, 50)
+        ],
+    }
+    p = Path("artifacts/TRAJ_PARITY_r04.json")
+    p.parent.mkdir(exist_ok=True)
+    p.write_text(json.dumps(out, indent=1))
+    summary = {k: out[k] for k in ("max_rel_divergence",
+                                   "max_rel_divergence_after_step10",
+                                   "final_loss", "wall_s")}
+    print(json.dumps(summary, indent=1), flush=True)
+    print(f"wrote {p}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

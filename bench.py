@@ -228,6 +228,14 @@ def section_matrix() -> list[dict]:
          "auto"),
         ("batchtopk", dict(activation="batchtopk", topk_k=32, l1_coeff=0.0), "auto"),
         ("jumprelu", dict(activation="jumprelu", l1_coeff=0.0), "auto"),
+        # AuxK step cost: aux_dead_steps=1 keeps the dead set non-empty so
+        # the timed step includes the full aux path (approx_max_k ranking
+        # over the masked [B,H] pre-acts, dense-matmul aux decode, fired
+        # scatter) — the worst case
+        ("topk_auxk",
+         dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
+              aux_dead_steps=1),
+         "auto"),
     ]
     steps = int(os.environ.get("BENCH_MATRIX_STEPS", 12))
     dicts = tuple(

@@ -69,13 +69,16 @@ class Checkpointer:
         self._writer: threading.Thread | None = None
         self._writer_error: BaseException | None = None
 
-    def wait(self) -> None:
+    def wait(self, raise_error: bool = True) -> None:
         """Block until any in-flight background write has finished; raises
-        the write's exception here if it failed."""
+        the write's exception here if it failed (``raise_error=False``
+        joins only — ``save`` uses it so a failure surfaces AFTER the
+        collective state fetch, keeping collective entry symmetric across
+        hosts)."""
         if self._writer is not None:
             self._writer.join()
             self._writer = None
-        if self._writer_error is not None:
+        if raise_error and self._writer_error is not None:
             err, self._writer_error = self._writer_error, None
             raise err
 
@@ -155,13 +158,20 @@ class Checkpointer:
                 fetched[id(leaf)] = out
             return out
 
-        # serialize with any in-flight background write BEFORE fetching:
-        # one writer at a time, and a prior failure surfaces here
-        self.wait()
+        # serialize with any in-flight background write BEFORE fetching —
+        # but do NOT raise a previous write failure yet: the fetch below is
+        # a COLLECTIVE on a multi-host mesh, and only the writing process
+        # carries the error; raising before the fetch would leave every
+        # other host parked in process_allgather (asymmetric entry)
+        self.wait(raise_error=False)
 
         pathed = jax.tree_util.tree_flatten_with_path(state)[0]
         flat_state = {jax.tree_util.keystr(p): fetch(leaf) for p, leaf in pathed}
         weights = {k: fetch(x).astype(np.float32) for k, x in state.params.items()}
+        # collectives done — a stashed write failure can surface safely now
+        if self._writer_error is not None:
+            err, self._writer_error = self._writer_error, None
+            raise err
         primary = jax.process_index() == 0
         if self.save_dir is None and primary:
             self._create_save_dir()

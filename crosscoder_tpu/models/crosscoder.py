@@ -314,6 +314,10 @@ def get_losses(
     x = x.astype(dtype_of(cfg.enc_dtype))
     sparse = cfg.sparse_decode and cfg.activation == "topk"
     l0_penalty: jax.Array | float = 0.0
+    h = None            # pre-acts, kept when a later consumer (the
+                        # JumpReLU L0 penalty, the AuxK ranking) needs
+                        # them — shared explicitly rather than trusting
+                        # CSE to dedupe a second encode matmul
     if sparse:
         # factored TopK path: decode touches only the k active rows; the
         # rounding of recon through the compute dtype matches the dense
@@ -322,9 +326,6 @@ def get_losses(
         recon = recon_f32.astype(x.dtype)
         f = None
     elif cfg.activation == "jumprelu" and cfg.l0_coeff > 0:
-        # share the encode pre-acts with the L0 penalty (the JumpReLU
-        # paper's sparsity objective needs h near θ, which the
-        # post-activation f has zeroed)
         h = pre_acts(params, x)
         f = act_ops.apply(h, cfg, params)
         recon = decode(params, f)
@@ -332,7 +333,8 @@ def get_losses(
             h, params["log_theta"], cfg.jumprelu_bandwidth
         )
     else:
-        f = encode(params, x, cfg)
+        h = pre_acts(params, x)
+        f = act_ops.apply(h, cfg, params)
         recon = decode(params, f)
 
     xf = x.astype(jnp.float32)
@@ -374,13 +376,37 @@ def get_losses(
         else:
             fired = jnp.any(ff > 0, axis=0)
         k_aux = min(cfg.aux_k, d_hidden)
-        h_all = pre_acts(params, x).astype(jnp.float32)   # CSE'd with encode
-        masked = jnp.where(dead_mask[None, :], h_all, -jnp.inf)
-        avals, aidx = jax.lax.top_k(masked, k_aux)
-        # fewer dead than aux_k → -inf rows; zero them (no value, no grad)
-        avals = jnp.where(jnp.isfinite(avals), avals, 0.0).astype(x.dtype)
+        # Selection runs in the COMPUTE dtype with approx_max_k (the TPU
+        # PartialReduce instruction) — an exact fp32 top_k here cost more
+        # than the whole rest of the step at dict 2^15 (measured 498 vs
+        # 79 ms, bench matrix): it materialized [B, H] fp32 and paid the
+        # k=256 sort. Which near-top dead latent gets the aux gradient is
+        # heuristic anyway; values are re-GATHERED from the pre-acts so
+        # the encoder's gradient path is exact (same straight-through
+        # treatment as topk_vals_idx), and non-dead slots (when fewer
+        # dead than aux_k exist) are zeroed by the mask gather.
+        h_all = h if h is not None else pre_acts(params, x)
+        neg = jnp.asarray(jnp.finfo(h_all.dtype).min, h_all.dtype)
+        ranked = jnp.where(dead_mask[None, :], jax.lax.stop_gradient(h_all), neg)
+        _, aidx = jax.lax.approx_max_k(ranked, k_aux, recall_target=0.95)
+        aidx = jax.lax.stop_gradient(aidx)
+        avals = jnp.take_along_axis(h_all, aidx, axis=-1)
+        avals = jnp.where(jnp.take(dead_mask, aidx), avals, 0)
         e = jax.lax.stop_gradient(xf - rf)                # [B, n, d] fp32
-        e_hat = _sparse_decode_product(avals, aidx, params["W_dec"])
+        # dense decode of the scattered aux activations: at aux_k ≈ 8k the
+        # per-example row gather (_sparse_decode_product) materializes
+        # [B, aux_k, n, d] — ~10 GB of HBM traffic at bench shapes
+        # (measured 391 ms/step vs ~145 dense) — while B·aux_k/H ≈ 32
+        # hits per dictionary row means every W_dec row is read anyway:
+        # three MXU matmuls (fwd + the two VJPs) win outright, the same
+        # trade the sparse_decode notes above document for the main path.
+        f_aux = jnp.zeros((x.shape[0], d_hidden), x.dtype).at[
+            jnp.arange(x.shape[0])[:, None], aidx
+        ].add(avals.astype(x.dtype))
+        e_hat = jnp.einsum(
+            "bh,hnd->bnd", f_aux, params["W_dec"],
+            preferred_element_type=jnp.float32,
+        )
         num = jnp.mean(jnp.sum(jnp.square(e_hat - e), axis=(-2, -1)))
         den = jnp.mean(jnp.sum(jnp.square(e), axis=(-2, -1)))
         # no dead latents → e_hat ≡ 0 and the ratio is a gradient-free

@@ -245,8 +245,13 @@ def main(argv=None):
     ap.add_argument("--n-seqs", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=4)
     ap.add_argument("--norm-factors", type=str, default=None, help="a,b fold factors")
-    ap.add_argument("--demo-lm-steps", type=_positive_int, default=400)
-    ap.add_argument("--demo-cc-steps", type=_positive_int, default=1500)
+    # defaults ARE the recorded-expectation run (band_checked keys off
+    # equality with DEMO_DEFAULT_STEPS — literals here would let the two
+    # drift and silently demote the gate to the smoke thresholds)
+    ap.add_argument("--demo-lm-steps", type=_positive_int,
+                    default=DEMO_DEFAULT_STEPS[0])
+    ap.add_argument("--demo-cc-steps", type=_positive_int,
+                    default=DEMO_DEFAULT_STEPS[1])
     ap.add_argument("--out", type=str, default=None, help="write metrics JSON here")
     ap.add_argument(
         "--platform", type=str, default=None, choices=("cpu", "tpu"),
