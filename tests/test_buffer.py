@@ -267,6 +267,26 @@ def test_multi_source_hooks(lm_pair, tokens):
     np.testing.assert_allclose(got, want[:, 1:], rtol=1e-2, atol=1e-2)
 
 
+def test_multi_source_mixed_sites(lm_pair, tokens):
+    """hook_points mixing residual and sublayer sites (round-4 hook-site
+    generality): a crosscoder over {resid_pre, attn_out, mlp_out} of the
+    same model pair harvests each site faithfully (store slab == the
+    corresponding single-site capture)."""
+    lm_cfg, params = lm_pair
+    cfg = make_cfg(hook_points=("blocks.1.hook_resid_pre",
+                                "blocks.1.hook_attn_out",
+                                "blocks.2.hook_mlp_out"))
+    b = PairedActivationBuffer(cfg, lm_cfg, params, tokens)
+    assert cfg.n_sources == 6                    # 2 models × 3 sites
+    assert b._store.shape == (1024, 6, 32)
+    for si, hp in enumerate(cfg.hook_points):
+        cache = lm.run_with_cache(params[0], tokens[:4], lm_cfg, [hp])
+        want = np.asarray(cache[hp].astype(jax.numpy.bfloat16), np.float32)
+        got = b._store[: 4 * 16, si].astype(np.float32).reshape(4, 16, 32)
+        np.testing.assert_allclose(got, want[:, 1:], rtol=1e-2, atol=1e-2,
+                                   err_msg=hp)
+
+
 def test_resume_roundtrip(lm_pair, tokens):
     """state_dict → fresh buffer → load_state_dict continues the token
     stream at the saved position with the saved norm factors."""
