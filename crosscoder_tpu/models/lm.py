@@ -261,19 +261,28 @@ def _mlp(x: jax.Array, lp: Mapping[str, jax.Array]) -> jax.Array:
 
 
 def _block(
-    resid: jax.Array, lp: Mapping[str, jax.Array], cfg: LMConfig, is_local: jax.Array
+    resid: jax.Array, lp: Mapping[str, jax.Array], cfg: LMConfig, is_local: jax.Array,
+    edit_attn: Callable[[jax.Array], jax.Array] | None = None,
+    edit_mlp: Callable[[jax.Array], jax.Array] | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One Gemma-2 transformer block (sandwich norms around attn and MLP).
 
     Returns ``(resid, attn_out, mlp_out)`` — the updated stream plus the two
     sublayer contributions exactly as they are ADDED to it (post the Gemma-2
     sandwich post-norms), which is what ``hook_attn_out``/``hook_mlp_out``
-    capture: the intermediates exist anyway, so exposing them is free."""
+    capture: the intermediates exist anyway, so exposing them is free.
+    ``edit_attn``/``edit_mlp`` intervene on a contribution BEFORE it joins
+    the stream (and before its capture) — the sublayer-site analogue of the
+    residual edits, used by CE-recovered evals of sublayer crosscoders."""
     a = _attention(_rms_norm(resid, lp["attn_norm"], cfg.rms_eps), lp, cfg, is_local)
     attn_out = _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+    if edit_attn is not None:
+        attn_out = edit_attn(attn_out)
     resid = resid + attn_out
     m = _mlp(_rms_norm(resid, lp["pre_ffw_norm"], cfg.rms_eps), lp)
     mlp_out = _rms_norm(m, lp["post_ffw_norm"], cfg.rms_eps)
+    if edit_mlp is not None:
+        mlp_out = edit_mlp(mlp_out)
     return resid + mlp_out, attn_out, mlp_out
 
 
@@ -400,7 +409,7 @@ def _forward_impl(
     cfg: LMConfig,
     capture: tuple[tuple[int, int], ...],
     edit_fns: tuple[Callable, ...],
-    edit_layers: tuple[int, ...],
+    edit_layers: tuple[tuple[int, int], ...],
     edit_values: tuple[jax.Array, ...],
     return_logits: bool,
     n_scan: int | None = None,
@@ -420,10 +429,17 @@ def _forward_impl(
     want_attn = any(c == _SITE_ATTN for _, c in capture)
     want_mlp = any(c == _SITE_MLP for _, c in capture)
     cap_buf = jnp.zeros((n_cap, B, S, D), dtype=dt) if n_cap else None
-    edit_arr = jnp.asarray(edit_layers, dtype=jnp.int32) if edit_layers else None
+    edit_site_codes = tuple(c for _, c in edit_layers)      # static
+    edit_arr = (
+        jnp.asarray([l for l, _ in edit_layers], dtype=jnp.int32)
+        if edit_layers else None
+    )
 
     def apply_hooks(resid, i):
+        # residual-site edits only; sublayer-site edits run inside _block
         for j, fn in enumerate(edit_fns):
+            if edit_site_codes[j] != _SITE_RESID:
+                continue
             edited = fn(resid, edit_values[j])
             resid = jnp.where(edit_arr[j] == i, edited, resid)
         return resid
@@ -441,7 +457,28 @@ def _forward_impl(
         resid = apply_hooks(resid, i)
         buf = _capture_into(buf, resid, i, cap_arr, _SITE_RESID, cap_sites)
         is_local = (i % 2) == 0                             # even layers: sliding window
-        resid, attn_out, mlp_out = _block(resid, lp, cfg, is_local)
+
+        def editor_for(site):
+            # sublayer-site edits, applied to the contribution at its own
+            # layer BEFORE it joins the stream (and before capture). The
+            # site selection is static; layer matching is the same
+            # one-hot where-chain as the residual edits.
+            js = [j for j, c in enumerate(edit_site_codes) if c == site]
+            if not js:
+                return None
+
+            def ed(out):
+                for j in js:
+                    edited = edit_fns[j](out, edit_values[j])
+                    out = jnp.where(edit_arr[j] == i, edited, out)
+                return out
+
+            return ed
+
+        resid, attn_out, mlp_out = _block(
+            resid, lp, cfg, is_local,
+            edit_attn=editor_for(_SITE_ATTN), edit_mlp=editor_for(_SITE_MLP),
+        )
         if want_attn:
             buf = _capture_into(buf, attn_out, i, cap_arr, _SITE_ATTN, cap_sites)
         if want_mlp:
@@ -472,19 +509,16 @@ def forward(
     - ``capture``: hook-point strings to record — the ``run_with_cache(
       names_filter=...)`` equivalent (reference buffer.py:81-89). The cache
       maps each string to a [B, S, d_model] array.
-    - ``edits``: interventions applied to the residual stream BEFORE capture
-      at the same layer — the ``run_with_hooks`` equivalent (nb:cell 29).
+    - ``edits``: interventions applied BEFORE capture at the same hook —
+      the ``run_with_hooks`` equivalent (nb:cell 29). Residual sites edit
+      the stream; ``attn_out``/``mlp_out`` sites edit that sublayer's
+      contribution before it joins the stream (so CE-recovered splicing
+      works for sublayer-trained crosscoders too).
     - ``return_logits=False`` skips the unembedding (the d_model→256k matmul
       dominates harvest FLOPs above the hook layer; harvesting never needs it).
     """
     cap_pairs = _hook_layers(cfg, capture)
     edit_pairs = _hook_layers(cfg, [e.hook_point for e in edits])
-    if any(code != _SITE_RESID for _, code in edit_pairs):
-        raise ValueError(
-            "activation edits support residual-stream sites only "
-            "(resid_pre/resid_post); attn_out/mlp_out are capture-only"
-        )
-    edit_layers = tuple(layer for layer, _ in edit_pairs)
     edit_fns = tuple(e.fn for e in edits)
     zeros = None
     values = []
@@ -502,7 +536,7 @@ def forward(
         else min(cfg.n_layers, max(_scan_stop(cap_pairs), _scan_stop(edit_pairs)))
     )
     logits, cap_buf = _forward_impl(
-        params, tokens, cfg, cap_pairs, edit_fns, edit_layers, tuple(values),
+        params, tokens, cfg, cap_pairs, edit_fns, edit_pairs, tuple(values),
         return_logits, n_scan=n_scan,
     )
     cache = {hp: cap_buf[i] for i, hp in enumerate(capture)}

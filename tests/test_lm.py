@@ -156,10 +156,64 @@ def test_sublayer_hook_validation(tiny_pair, tokens):
         lm.run_with_cache(params, tok, cfg, [f"blocks.{cfg.n_layers}.hook_attn_out"])
     with pytest.raises(ValueError, match="unsupported hook site"):
         lm.run_with_cache(params, tok, cfg, ["blocks.0.hook_z"])
-    # edits stay residual-only
-    with pytest.raises(ValueError, match="capture-only"):
-        lm.forward(params, tok, cfg,
-                   edits=[lm.Edit("blocks.0.hook_attn_out", lm.zero_edit)])
+
+
+def test_sublayer_edits(tiny_pair, tokens):
+    """Edits at attn_out/mlp_out intervene on the sublayer contribution
+    (the CE-splice path for sublayer-trained crosscoders): an identity
+    splice leaves logits unchanged; zero-ablation changes them; the edit
+    runs BEFORE same-layer capture."""
+    _, params, cfg = tiny_pair
+    tok = jnp.asarray(tokens)
+    hp = "blocks.1.hook_attn_out"
+    clean_logits, clean_cache = lm.forward(params, tok, cfg, capture=[hp])
+
+    # identity splice: replace post-BOS positions with the clean capture
+    spliced, _ = lm.forward(
+        params, tok, cfg,
+        edits=[lm.Edit(hp, lm.splice_edit, jnp.asarray(clean_cache[hp]))],
+    )
+    np.testing.assert_allclose(
+        np.asarray(spliced), np.asarray(clean_logits), rtol=1e-5, atol=1e-5
+    )
+
+    # zero ablation: must actually change the logits
+    zeroed, zcache = lm.forward(
+        params, tok, cfg, capture=[hp], edits=[lm.Edit(hp, lm.zero_edit)]
+    )
+    assert np.abs(np.asarray(zeroed) - np.asarray(clean_logits)).max() > 1e-3
+    # capture sees the EDITED contribution (edit-before-capture order)
+    np.testing.assert_array_equal(np.asarray(zcache[hp]), 0.0)
+
+    # mlp_out site too
+    hp2 = "blocks.2.hook_mlp_out"
+    zeroed2, _ = lm.forward(params, tok, cfg, edits=[lm.Edit(hp2, lm.zero_edit)])
+    assert np.abs(np.asarray(zeroed2) - np.asarray(clean_logits)).max() > 1e-3
+
+
+def test_ce_eval_fixed_points_at_attn_out(tiny_pair, tokens):
+    """CE-recovered eval machinery at a sublayer hook: identity
+    reconstruction recovers exactly 1, zero reconstruction matches the
+    zero-ablation baseline (recovered 0 up to the BOS-handling delta)."""
+    from crosscoder_tpu.analysis.ce_eval import get_ce_recovered_metrics
+
+    _, params, cfg = tiny_pair
+    hp = "blocks.1.hook_attn_out"
+    m = get_ce_recovered_metrics(
+        np.asarray(tokens), cfg, [params, params], hp, lambda x: x, chunk=2
+    )
+    assert m["ce_recovered_A"] == pytest.approx(1.0, abs=1e-3)
+    assert m["ce_recovered_B"] == pytest.approx(1.0, abs=1e-3)
+    z = get_ce_recovered_metrics(
+        np.asarray(tokens), cfg, [params, params], hp, jnp.zeros_like, chunk=2
+    )
+    # zero reconstruction ≈ the zero-ablation baseline: recovered collapses
+    # toward 0 (not exactly — splice keeps BOS clean while the ablation
+    # zeroes it too, same delta the resid-site oracle documents). On a
+    # random-init LM the CE DIRECTION of an ablation is noise, so only the
+    # fixed-point relations are asserted, not which way CE moved.
+    assert z["ce_recovered_A"] < 0.5 and z["ce_recovered_B"] < 0.5
+    assert abs(z["ce_spliced_A"] - m["ce_spliced_A"]) > 1e-3
 
 
 def test_ce_loss_parity(tiny_pair, tokens):
