@@ -69,6 +69,8 @@ ARMS = {
     "topk_auxk_strong": dict(activation="topk", topk_k=K, l1_coeff=0.0,
                              aux_k=2 * K, aux_dead_steps=300,
                              aux_k_coeff=0.25),
+    # BatchTopK at the same k: global k·B threshold instead of per-row
+    "batchtopk": dict(activation="batchtopk", topk_k=K, l1_coeff=0.0),
     # ReLU+L1 grid: the arm landing nearest L0=K is the matched baseline
     "relu_l1_1": dict(activation="relu", l1_coeff=1.0),
     "relu_l1_2": dict(activation="relu", l1_coeff=2.0),
@@ -203,6 +205,10 @@ def main() -> None:
             round((ta["eval_l2"] - tk["eval_l2"]) / tk["eval_l2"], 4),
         "wall_s": {n: r["wall_s"] for n, r in results["runs"].items()},
     }
+    if "batchtopk" in results["runs"]:
+        results["summary"]["final"]["batchtopk"] = (
+            results["runs"]["batchtopk"]["eval_curve"][-1]
+        )
     if "topk_auxk_strong" in results["runs"]:
         ts = results["runs"]["topk_auxk_strong"]["eval_curve"][-1]
         tcurve = results["runs"]["topk_auxk_strong"]["train_curve"]
