@@ -71,6 +71,15 @@ ARMS = {
                              aux_k_coeff=0.25),
     # BatchTopK at the same k: global k·B threshold instead of per-row
     "batchtopk": dict(activation="batchtopk", topk_k=K, l1_coeff=0.0),
+    # JumpReLU with the paper's L0 objective: λ grid bracketing the L2/L0
+    # equilibrium near L0≈K (slope of the measured ReLU frontier there)
+    "jumprelu_l0_03": dict(activation="jumprelu", l1_coeff=0.0, l0_coeff=0.3),
+    "jumprelu_l0_1": dict(activation="jumprelu", l1_coeff=0.0, l0_coeff=1.0),
+    # at the paper-default bandwidth 0.001 the θ gradient is ~dead (both
+    # λ above land at identical L0≈6k); a wider STE bandwidth gives the
+    # threshold a live gradient — the knob a practitioner would turn
+    "jumprelu_bw05": dict(activation="jumprelu", l1_coeff=0.0, l0_coeff=1.0,
+                          jumprelu_bandwidth=0.05, jumprelu_theta=0.01),
     # ReLU+L1 grid: the arm landing nearest L0=K is the matched baseline
     "relu_l1_1": dict(activation="relu", l1_coeff=1.0),
     "relu_l1_2": dict(activation="relu", l1_coeff=2.0),
