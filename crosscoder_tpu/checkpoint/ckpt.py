@@ -37,24 +37,46 @@ import numpy as np
 from crosscoder_tpu.config import CrossCoderConfig
 
 
+def _fsync_dir(path: Path) -> None:
+    """fsync a directory so a just-completed ``os.replace`` rename is
+    durable (file-content fsync alone does not persist the directory
+    entry). Each artifact's rename is synced before the next begins, so
+    the meta marker's durability implies its predecessors' — a power loss
+    can never leave meta on disk without the weights it vouches for."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def _atomic_savez(path: Path, arrays: dict[str, np.ndarray]) -> None:
     """npz write that becomes visible all-or-nothing: stream into a
-    ``.tmp`` sibling, then ``os.replace`` (atomic on POSIX). A process
-    killed mid-write leaves only the tmp file, which every reader path
-    (``latest_save``/``restore``) ignores."""
+    ``.tmp`` sibling, fsync, ``os.replace`` (atomic on POSIX), fsync the
+    directory. A process killed mid-write leaves only the tmp file, which
+    every reader path (``latest_save``/``restore``) ignores; the fsyncs
+    extend the guarantee to power loss, and cost nothing on the critical
+    path now that writes ride the background thread."""
     tmp = path.with_name(path.name + ".tmp")
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_dir(path.parent)
 
 
 def _atomic_write_text(path: Path, text: str) -> None:
     """Atomic sibling of :func:`_atomic_savez` for the JSON artifacts — the
     meta file is the save's completion marker, so it especially must never
-    exist half-written."""
+    exist half-written (or durable ahead of the files it marks)."""
     tmp = path.with_name(path.name + ".tmp")
-    tmp.write_text(text)
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_dir(path.parent)
 
 
 class Checkpointer:
