@@ -205,6 +205,40 @@ def test_auxk_sharded_step_runs():
     tr.close()
 
 
+def test_auxk_with_source_sharding_matches_dict_sharding():
+    """EP-style source-axis sharding (cfg.shard_sources) with AuxK: the
+    replicated steps_since_fired tracker and the aux loss must produce
+    the same trajectory as the default dict-axis TP sharding."""
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+
+    def run(shard_sources):
+        cfg = _cfg(
+            activation="topk", topk_k=4, aux_dead_steps=1, n_models=2,
+            hook_points=("blocks.1.hook_resid_pre", "blocks.2.hook_resid_pre"),
+            data_axis_size=2, model_axis_size=4, shard_sources=shard_sources,
+        )
+        mesh = mesh_lib.mesh_from_cfg(cfg)
+        tr = Trainer(cfg, mesh=mesh)
+        losses, aux_losses = [], []
+        for _ in range(3):
+            m = tr.step()
+            losses.append(float(jax.device_get(m["loss"])))
+            # the aux term itself, not just its (warmup-scaled, tiny)
+            # contribution to the total — an EP-specific mis-scaling of
+            # the aux loss must fail loudly here
+            aux_losses.append(float(jax.device_get(m["aux_loss"])))
+        since = np.asarray(jax.device_get(tr.state.aux["steps_since_fired"]))
+        tr.close()
+        return losses, aux_losses, since
+
+    l_tp, a_tp, s_tp = run(False)
+    l_ep, a_ep, s_ep = run(True)
+    np.testing.assert_allclose(l_ep, l_tp, rtol=1e-5)
+    np.testing.assert_allclose(a_ep, a_tp, rtol=1e-5)
+    assert any(a > 0 for a in a_tp)        # the aux path actually engaged
+    np.testing.assert_array_equal(s_ep, s_tp)
+
+
 def test_config_rejects_bad_aux_k():
     with pytest.raises(ValueError):
         _cfg(aux_k=-1)
