@@ -111,6 +111,21 @@ def bench_step(cfg, n_steps: int, warmup: int = 3) -> dict:
     # steps (1% at the reference cadence), so the bare step is the
     # throughput-defining variant
     step_fn = make_train_step(cfg, mesh, tx, shardings, with_metrics=False)
+    # AuxK amortization (cfg.aux_every > 1): alternate the aux-on and
+    # aux-off compiled variants exactly as the Trainer does, so the timed
+    # mix IS the production step cost
+    step_fn_off = None
+    if cfg.aux_k > 0 and cfg.aux_every > 1:
+        if warmup < 2:
+            raise ValueError("aux_every benching needs warmup >= 2 (both variants)")
+        step_fn_off = make_train_step(
+            cfg, mesh, tx, shardings, with_metrics=False, aux_on=False
+        )
+
+    def pick(i: int):
+        if step_fn_off is None or i % cfg.aux_every == 0:
+            return step_fn
+        return step_fn_off
 
     batch_sh = mesh_lib.batch_sharding(mesh)
     key = jax.random.key(0)
@@ -134,12 +149,12 @@ def bench_step(cfg, n_steps: int, warmup: int = 3) -> dict:
     )
 
     for i in range(warmup):
-        state, metrics = step_fn(state, batches[i % 4], scale)
+        state, metrics = pick(i)(state, batches[i % 4], scale)
     _sync(metrics["loss"])
 
     t0 = time.perf_counter()
     for i in range(n_steps):
-        state, metrics = step_fn(state, batches[i % 4], scale)
+        state, metrics = pick(i)(state, batches[i % 4], scale)
     loss = _sync(metrics["loss"])   # one ~70ms RTT amortized over n_steps
     dt = time.perf_counter() - t0
     del state, batches
@@ -229,15 +244,22 @@ def section_matrix() -> list[dict]:
         ("batchtopk", dict(activation="batchtopk", topk_k=32, l1_coeff=0.0), "auto"),
         ("jumprelu", dict(activation="jumprelu", l1_coeff=0.0), "auto"),
         # AuxK step cost: aux_dead_steps=1 keeps the dead set non-empty so
-        # the timed step includes the full aux path (approx_max_k ranking
+        # aux-on steps include the full aux path (approx_max_k ranking
         # over the masked [B,H] pre-acts, dense-matmul aux decode, fired
-        # scatter) — the worst case
+        # scatter) — the worst case. `topk_auxk` is the production
+        # recommendation (aux_every=8 amortization; quality within noise
+        # of per-step, artifacts/ACT_QUALITY_r05.json); `_perstep` is the
+        # unamortized Gao-exact recipe for comparison (the r04 number).
         ("topk_auxk",
+         dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
+              aux_dead_steps=1, aux_every=8),
+         "auto"),
+        ("topk_auxk_perstep",
          dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
               aux_dead_steps=1),
          "auto"),
     ]
-    steps = int(os.environ.get("BENCH_MATRIX_STEPS", 12))
+    steps = int(os.environ.get("BENCH_MATRIX_STEPS", 16))
     dicts = tuple(
         int(x) for x in os.environ.get(
             "BENCH_MATRIX_DICTS", f"{2**15},{2**16},{2**17}"

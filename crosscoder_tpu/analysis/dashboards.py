@@ -55,9 +55,11 @@ class FeatureVisConfig:
     include_logit_lens: bool = True      # the fork's logit tables (nb:cells 33-42)
     # sae_vis-style interval sequence groups (nb:cells 36-42): besides the
     # top-k max-activating group, sample sequences whose PEAK activation
-    # falls in each of n equal bands of (0, max_act] — the mid/low-strength
-    # firing contexts a top-k-only view hides. 0 disables.
-    n_quantile_groups: int = 4
+    # falls in each of n EQUAL-WIDTH value bands of (0, max_act] — the
+    # mid/low-strength firing contexts a top-k-only view hides. (Named for
+    # what it builds: value intervals, not sae_vis's equal-count rank
+    # quantiles.) 0 disables.
+    n_interval_groups: int = 4
     seqs_per_group: int = 4
 
     def __post_init__(self) -> None:
@@ -74,7 +76,7 @@ class FeatureData:
     acts_sample: np.ndarray              # nonzero activations (density plot)
     top_seqs: list[dict] = field(default_factory=list)
     # each: {tokens: [int], values: [float], peak: int}
-    quantile_groups: list[dict] = field(default_factory=list)
+    interval_groups: list[dict] = field(default_factory=list)
     # each: {label: str, lo: float, hi: float, seqs: [same dicts as top_seqs]}
     logit_lens: list[dict] = field(default_factory=list)
     # per source: {source: int, promoted: [(token_id, value)...],
@@ -220,10 +222,10 @@ class FeatureVisData:
             # top edge), excluding anything already shown in the top-k group
             groups: list[dict] = []
             mx = float(a.max())
-            if vis_cfg.n_quantile_groups > 0 and mx > 0:
+            if vis_cfg.n_interval_groups > 0 and mx > 0:
                 shown = set(int(si) for si in order)
-                edges = np.linspace(0.0, mx, vis_cfg.n_quantile_groups + 1)
-                for j in range(vis_cfg.n_quantile_groups - 1, -1, -1):
+                edges = np.linspace(0.0, mx, vis_cfg.n_interval_groups + 1)
+                for j in range(vis_cfg.n_interval_groups - 1, -1, -1):
                     band = np.where(
                         (peak_per_seq > edges[j]) & (peak_per_seq <= edges[j + 1])
                     )[0]
@@ -252,7 +254,7 @@ class FeatureVisData:
                 cosine_sim=float(cos[fi]),
                 acts_sample=nz[:10_000],
                 top_seqs=seqs,
-                quantile_groups=groups,
+                interval_groups=groups,
                 logit_lens=lens_tables[fi],
             ))
         return cls(vis_cfg, out)
@@ -287,9 +289,9 @@ class FeatureVisData:
         for fd in self.features:
             rows = [seq_row(seq, fd.max_act) for seq in fd.top_seqs]
             group_html = ""
-            if fd.quantile_groups:
+            if fd.interval_groups:
                 blocks = []
-                for grp in fd.quantile_groups:
+                for grp in fd.interval_groups:
                     grows = "".join(seq_row(s, fd.max_act) for s in grp["seqs"])
                     blocks.append(
                         f'<div class="group"><h3>{_html.escape(grp["label"])}'

@@ -109,6 +109,16 @@ class CrossCoderConfig:
     aux_dead_steps: int = 500       # a latent is "dead" after this many
                                     # consecutive steps without firing
                                     # (500 steps x batch 4096 ≈ 2M rows)
+    aux_every: int = 1              # run the aux ranking+decode every Nth
+                                    # step (fired-tracking stays per-step,
+                                    # so deadness is always current). The
+                                    # full aux path costs 2.2-2.7x a plain
+                                    # TopK step (BENCH_r04 matrix); N
+                                    # amortizes that to ~(N-1+2.7)/N — at
+                                    # N=8, ~1.2x. 1 = the per-step Gao
+                                    # et al. recipe. Quality under
+                                    # amortization: artifacts/
+                                    # ACT_QUALITY_r05.json.
     batchtopk_threshold: float = 0.0   # >0: batchtopk EVAL mode — a fixed
                                     # global threshold (from
                                     # crosscoder.calibrate_batchtopk_threshold)
@@ -256,6 +266,12 @@ class CrossCoderConfig:
             )
         if self.aux_k > 0 and self.aux_dead_steps < 1:
             raise ValueError("aux_dead_steps must be >= 1 when aux_k > 0")
+        if self.aux_every < 1:
+            raise ValueError(f"aux_every must be >= 1, got {self.aux_every}")
+        if self.stop_poll_every < 1:
+            raise ValueError(
+                f"stop_poll_every must be >= 1, got {self.stop_poll_every}"
+            )
 
     # --- derived quantities -------------------------------------------------
     @property

@@ -59,6 +59,23 @@ from crosscoder_tpu.utils import pipeline
 _BF16 = np.dtype(jnp.bfloat16.dtype)
 
 
+class _SingleDispatchJob:
+    """Adapter giving an already-dispatched harvest future the
+    :class:`crosscoder_tpu.models.lm.SegmentedHarvest` step protocol (used
+    where segmentation doesn't apply, e.g. the seq-parallel harvest)."""
+
+    n_steps = 1
+
+    def __init__(self, result) -> None:
+        self._result = result
+
+    def step(self) -> bool:
+        return False
+
+    def result(self):
+        return self._result
+
+
 class PairedActivationBuffer:
     """Serves shuffled paired activations for crosscoder training.
 
@@ -314,6 +331,19 @@ class PairedActivationBuffer:
     # Writes go tail-first (rotation by `_cyc_rot`), then follow the pointer
     # through the served prefix: a chunk at write offset w of r rows is safe
     # once  w + r ≤ pointer + tail.
+    #
+    # The invariant constrains the WRITE (the drain's scatter), not the
+    # harvest forward — a dispatched chunk touches no store row until it is
+    # drained. So dispatch runs AHEAD of the budget (bounded by
+    # PIPELINE_DEPTH, paced at ~one chunk per serve so forwards spread
+    # evenly through the device queue instead of clumping) and only the
+    # drain is budget-gated. Without the lead, the cycle's last chunk can
+    # only be DISPATCHED at the trigger serve — refill 0.5's budget frees
+    # its positions exactly then — queuing a full LM forward inside the
+    # trigger step (the measured 111 ms refresh bubble, BENCH_r04 e2e;
+    # the stall being amortized is the reference's blocking refresh,
+    # reference buffer.py:121-122). With it, the trigger point finds every
+    # chunk harvested and only scatters + reshuffles.
 
     def _begin_cycle(self, num_batches: int | None = None) -> None:
         rows_per_seq = self.cfg.seq_len - 1
@@ -329,6 +359,7 @@ class PairedActivationBuffer:
             self.token_pointer = (self.token_pointer - dropped) % self.tokens.shape[0]
             self._global_seq -= dropped
             self._cyc_inflight = []
+            self._cyc_job = None
         if num_batches is None:
             num_batches = self._refill_batches()
         b = self.cfg.batch_size
@@ -348,6 +379,35 @@ class PairedActivationBuffer:
         self._cyc_write = 0             # rows dispatched so far
         self._cyc_drained = 0           # rows landed in the store
         self._cyc_inflight: list[tuple] = []
+        self._cyc_job: tuple | None = None   # (job, n, seq_globals, woff) mid-dispatch
+        # dispatch pacing: spread the cycle's harvest quanta evenly over the
+        # serves before the trigger, so every train step queues the same
+        # slice of harvest device-time (the refresh-bubble fix; see the
+        # invariant notes above)
+        n_chunks = -(-num_batches // self._chunk_seqs)
+        serves = max(1, trigger // b + 1)
+        self._cyc_segs_per_serve = -(-n_chunks * self._segs_per_chunk() // serves)
+
+    def _segs_per_chunk(self) -> int:
+        """Dispatch quanta one harvest chunk costs (pacing denominator)."""
+        if self._seq_mesh is not None:
+            return 1            # seq-parallel harvest stays one dispatch
+        return lm.SegmentedHarvest.count(
+            self.lm_cfg, self.hook_points, len(self.model_params)
+        )
+
+    def _harvest_job(self, padded_tokens: np.ndarray):
+        """A segment-steppable harvest job for one fixed-shape chunk (the
+        incremental-refill counterpart of :meth:`_harvest_dev`)."""
+        if self._seq_mesh is not None:
+            return _SingleDispatchJob(self._harvest_dev(padded_tokens))
+        tok = jnp.asarray(padded_tokens)
+        if self.batch_sharding is not None:
+            tok = jax.device_put(tok, self.batch_sharding)
+        return lm.SegmentedHarvest(
+            self.model_params, tok, self.lm_cfg, self.hook_points,
+            out_dtype=jnp.bfloat16,
+        )
 
     def _cyc_positions(self, woff: int, n_rows: int) -> np.ndarray:
         """Store positions for cycle write offsets [woff, woff+n_rows):
@@ -356,16 +416,35 @@ class PairedActivationBuffer:
         order = np.where(j < self._cyc_tail, self._cyc_rot + j, j - self._cyc_tail)
         return self._perm[order]
 
-    def _dispatch_chunk(self) -> None:
+    def _create_job(self) -> tuple:
+        """Open the next chunk's harvest job (dispatches nothing yet) and
+        account its sequences as dispatched — the token stream advances at
+        job creation, so the abandon-rewind in ``_begin_cycle`` covers jobs
+        mid-dispatch exactly like landed chunks."""
         rows_per_seq = self.cfg.seq_len - 1
         n_seqs = min(self._chunk_seqs, self._cyc_batches - self._cyc_seq_done)
         seq_globals = self._global_seq + np.arange(n_seqs)
         padded, n = self._pad_chunk(self._take_tokens(n_seqs))
-        self._cyc_inflight.append(
-            (self._harvest_dev(padded), n, seq_globals, self._cyc_write)
-        )
+        entry = (self._harvest_job(padded), n, seq_globals, self._cyc_write)
         self._cyc_seq_done += n_seqs
         self._cyc_write += n_seqs * rows_per_seq
+        return entry
+
+    def _step_job(self) -> bool:
+        """Advance the harvest pipeline by ONE dispatch quantum: open a new
+        job if none is active (depth-bounded), else step the active one;
+        completed jobs move to the drain queue. Returns False when the
+        cycle has nothing left to dispatch right now."""
+        if self._cyc_job is None:
+            if (self._cyc_seq_done >= self._cyc_batches
+                    or len(self._cyc_inflight) + 1 > self.PIPELINE_DEPTH):
+                return False
+            self._cyc_job = self._create_job()
+        job, n, seq_globals, woff = self._cyc_job
+        if not job.step():
+            self._cyc_inflight.append((job.result(), n, seq_globals, woff))
+            self._cyc_job = None
+        return True
 
     def _drain_one(self) -> None:
         cfg = self.cfg
@@ -379,43 +458,40 @@ class PairedActivationBuffer:
         self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
         self._cyc_drained += rows.shape[0]
 
+    def _head_drainable(self) -> bool:
+        """Write-safety check for the OLDEST in-flight chunk: its store
+        positions are freed once the serve pointer (plus the static tail)
+        covers its write extent."""
+        if not self._cyc_inflight:
+            return False
+        _, n, _, woff = self._cyc_inflight[0]
+        return woff + n * (self.cfg.seq_len - 1) <= self.pointer + self._cyc_tail
+
     def _advance_cycle(self) -> None:
-        """Dispatch any harvest chunks whose target positions the serve
-        pointer has freed; fetch+scatter aged/finished ones. Called after
-        every served batch — this is where the refresh work actually
-        happens in steady state, a chunk or so per train step."""
-        rows_per_seq = self.cfg.seq_len - 1
-        budget = self.pointer + self._cyc_tail
-        while self._cyc_seq_done < self._cyc_batches:
-            next_rows = min(self._chunk_seqs, self._cyc_batches - self._cyc_seq_done) * rows_per_seq
-            if self._cyc_write + next_rows > budget:
-                break
-            self._dispatch_chunk()
-            while len(self._cyc_inflight) >= self.PIPELINE_DEPTH:
-                self._drain_one()
-        # opportunistically land chunks the device already finished, so the
-        # trigger point finds (almost) nothing left to wait for. NOT on a
-        # multi-process mesh: is_ready() is host-local timing, and a drain
-        # dispatches a (collective) scatter — processes must make identical
-        # dispatch decisions or their rendezvous orders diverge. There the
-        # deterministic depth-bound/trigger drains do all the landing.
-        if jax.process_count() > 1:
-            return
-        while len(self._cyc_inflight) > 1:
-            try:
-                ready = self._cyc_inflight[0][0].is_ready()
-            except Exception:
-                break
-            if not ready:
-                break
+        """One serve's worth of refill progress: dispatch the paced number
+        of harvest quanta (``_cyc_segs_per_serve`` — the cycle's total
+        dispatch budget spread evenly over its serves, so every train step
+        queues the same slice of harvest device-time) and land every chunk
+        whose target positions the serve pointer has freed.
+
+        All decisions derive from host-replicated state (pointer, write
+        offsets, depth, the credit counter), so every process of a
+        multi-process mesh makes identical dispatch/drain choices — the
+        SPMD rendezvous-order requirement that ruled out the old
+        is_ready() opportunistic drain.
+        """
+        credit = self._cyc_segs_per_serve
+        while credit > 0 and self._step_job():
+            credit -= 1
+        while self._head_drainable():
             self._drain_one()
 
     def _finish_cycle(self) -> None:
         """Complete the cycle: dispatch the remainder (none in steady
-        state), land everything, re-shuffle, reset the read pointer."""
-        while self._cyc_seq_done < self._cyc_batches:
-            self._dispatch_chunk()
-            while len(self._cyc_inflight) >= self.PIPELINE_DEPTH:
+        state — the paced dispatches have already finished), land
+        everything, re-shuffle, reset the read pointer."""
+        while self._cyc_seq_done < self._cyc_batches or self._cyc_job is not None:
+            if not self._step_job():        # depth window full: free a slot
                 self._drain_one()
         while self._cyc_inflight:
             self._drain_one()
@@ -525,6 +601,7 @@ class PairedActivationBuffer:
         # chunks WITHOUT the abandon-rewind (that would shift the restored
         # pointer by sequences belonging to the pre-restore stream)
         self._cyc_inflight = []
+        self._cyc_job = None
         self._cyc_seq_done = 0
         # restore must be independent of pre-restore buffer history: reset
         # the permutation so the refill lands rows in harvest order, exactly

@@ -292,6 +292,7 @@ def get_losses(
     cfg: CrossCoderConfig,
     with_metrics: bool = True,
     dead_mask: jax.Array | None = None,
+    track_fired: bool = False,
 ) -> LossOutput:
     """Full loss surface for a batch ``x: [batch, n_sources, d_in]``.
 
@@ -366,7 +367,12 @@ def get_losses(
     # Objective-relevant, so computed in the with_metrics=False step too.
     aux_loss: jax.Array | float = 0.0
     fired = None
-    if dead_mask is not None and cfg.aux_k > 0:
+    if track_fired or (dead_mask is not None and cfg.aux_k > 0):
+        # which latents fired this batch (the trainer's steps_since_fired
+        # update). Tracked on EVERY step even when the aux loss itself is
+        # amortized to every cfg.aux_every-th step — deadness must stay
+        # current, or a revived latent would keep receiving aux gradient
+        # for up to aux_every steps after coming back.
         d_hidden = params["W_dec"].shape[0]
         if sparse:
             hits = jnp.zeros((d_hidden,), jnp.int32).at[idx.reshape(-1)].add(
@@ -375,6 +381,8 @@ def get_losses(
             fired = hits > 0
         else:
             fired = jnp.any(ff > 0, axis=0)
+    if dead_mask is not None and cfg.aux_k > 0:
+        d_hidden = params["W_dec"].shape[0]
         k_aux = min(cfg.aux_k, d_hidden)
         # Selection runs in the COMPUTE dtype with approx_max_k (the TPU
         # PartialReduce instruction) — an exact fp32 top_k here cost more
@@ -473,6 +481,7 @@ def training_loss(
     l0_coeff: jax.Array | float | None = None,
     dead_mask: jax.Array | None = None,
     aux_coeff: jax.Array | float | None = None,
+    track_fired: bool = False,
 ) -> tuple[jax.Array, LossOutput]:
     """Scalar training objective ``l2 + l1_coeff · l1`` (reference
     ``trainer.py:44``) plus the full loss surface as aux.
@@ -482,7 +491,7 @@ def training_loss(
     """
     losses = get_losses(
         cast_params(params, dtype_of(cfg.enc_dtype)), x, cfg, with_metrics,
-        dead_mask=dead_mask,
+        dead_mask=dead_mask, track_fired=track_fired,
     )
     # TopK-style runs control sparsity structurally and typically set
     # l1_coeff=0 in config; the objective shape is the same either way.

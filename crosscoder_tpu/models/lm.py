@@ -596,6 +596,165 @@ def ce_loss(
 
 
 # ---------------------------------------------------------------------------
+# segmented harvest (sub-forward dispatch quanta for the refill pipeline)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_cap"))
+def _seg_start_impl(params: LMParams, tokens: jax.Array, cfg: LMConfig, n_cap: int):
+    B, S = tokens.shape
+    dt = dtype_of(cfg.dtype)
+    resid = params["embed"][tokens].astype(dt) * jnp.asarray(math.sqrt(cfg.d_model), dt)
+    buf = jnp.zeros((n_cap, B, S, cfg.d_model), dt)
+    return resid, buf
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "capture", "k"), donate_argnums=(1, 2)
+)
+def _seg_scan_impl(
+    params: LMParams, resid: jax.Array, buf: jax.Array, lo: jax.Array,
+    cfg: LMConfig, capture: tuple[tuple[int, int], ...], k: int,
+):
+    """Blocks [lo, lo+k) of the capture forward, carrying (resid, buf).
+
+    ``lo`` is TRACED (``dynamic_slice`` on the stacked layer leaves), so one
+    compiled program serves every segment of a given width — no per-range
+    recompiles and no pre-split param copies. Per-layer math is identical to
+    ``_forward_impl``'s scan body (same ops in the same order); only the
+    scan is cut into sub-scans."""
+    n_cap = len(capture)
+    cap_arr = jnp.asarray([l for l, _ in capture], jnp.int32) if n_cap else None
+    cap_sites = jnp.asarray([c for _, c in capture], jnp.int32) if n_cap else None
+    want_attn = any(c == _SITE_ATTN for _, c in capture)
+    want_mlp = any(c == _SITE_MLP for _, c in capture)
+    stacked = jax.tree_util.tree_map(
+        lambda x: jax.lax.dynamic_slice_in_dim(x, lo, k, axis=0), params["layers"]
+    )
+    layer_ids = lo + jnp.arange(k, dtype=jnp.int32)
+
+    def body(carry, xs):
+        resid, buf = carry
+        lp, i = xs
+        buf = _capture_into(buf, resid, i, cap_arr, _SITE_RESID, cap_sites)
+        is_local = (i % 2) == 0
+        resid, attn_out, mlp_out = _block(resid, lp, cfg, is_local)
+        if want_attn:
+            buf = _capture_into(buf, attn_out, i, cap_arr, _SITE_ATTN, cap_sites)
+        if want_mlp:
+            buf = _capture_into(buf, mlp_out, i, cap_arr, _SITE_MLP, cap_sites)
+        return (resid, buf), None
+
+    (resid, buf), _ = jax.lax.scan(body, (resid, buf), (stacked, layer_ids))
+    return resid, buf
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "capture", "n_scan", "out_dtype"))
+def _seg_finish_impl(
+    resids: tuple, bufs: tuple, cfg: LMConfig,
+    capture: tuple[tuple[int, int], ...], n_scan: int, out_dtype,
+):
+    """Virtual-layer capture per model + the model-major source stack —
+    output shape/order identical to :func:`run_with_cache_multi`."""
+    cap_arr = jnp.asarray([l for l, _ in capture], jnp.int32)
+    cap_sites = jnp.asarray([c for _, c in capture], jnp.int32)
+    outs = []
+    for resid, buf in zip(resids, bufs):
+        buf = _capture_into(buf, resid, jnp.int32(n_scan), cap_arr, _SITE_RESID, cap_sites)
+        outs.extend(buf[i] for i in range(buf.shape[0]))
+    out = jnp.stack(outs, axis=2)                  # [B, S, n_sources, D]
+    return out.astype(out_dtype) if out_dtype is not None else out
+
+
+class SegmentedHarvest:
+    """:func:`run_with_cache_multi` as a sequence of ~equal small device
+    dispatches instead of one monolithic one.
+
+    Why: the replay buffer's incremental refill interleaves harvest
+    forwards with train steps on ONE serial device queue. At Gemma-2-2B
+    shapes a whole-chunk forward is ~108 ms of device time — an indivisible
+    quantum that lands in whichever train step queues behind it, producing
+    the measured 111 ms refresh bubble (BENCH_r04 e2e max-vs-median step).
+    Splitting the forward into ``SEG_LAYERS``-block sub-scans (~10-15 ms
+    each) lets the buffer meter harvest work evenly across serves; the math
+    is the same per-layer op sequence, so results match the monolithic path
+    (asserted by tests/test_lm.py). No reference counterpart — the
+    reference harvests in one blocking stall (reference buffer.py:78-96).
+
+    Protocol: ``step()`` dispatches one quantum (async, never blocks on the
+    device) and returns False once the final stacked result has been
+    dispatched; ``result()`` returns the ``[B, S, n_sources, D]`` capture
+    array (dispatching any remainder first). ``n_steps`` is the total
+    ``step()`` budget, for pacing.
+    """
+
+    SEG_LAYERS = 3
+
+    def __init__(
+        self,
+        params_seq: Sequence[LMParams],
+        tokens: jax.Array,
+        cfg: LMConfig,
+        hook_points: Sequence[str],
+        out_dtype=None,
+    ) -> None:
+        self.params_seq = tuple(params_seq)
+        self.tokens = tokens
+        self.cfg = cfg
+        self.capture = _hook_layers(cfg, tuple(hook_points))
+        self.n_scan = min(cfg.n_layers, _scan_stop(self.capture))
+        self.out_dtype = out_dtype
+        self.n_steps = self.count(cfg, hook_points, len(self.params_seq))
+        self._model_idx = 0
+        self._lo = 0
+        self._resid = self._buf = None
+        self._done_resids: list = []
+        self._done_bufs: list = []
+        self._out = None
+
+    @classmethod
+    def count(cls, cfg: LMConfig, hook_points: Sequence[str], n_models: int) -> int:
+        """``step()`` calls a job over these hooks will need (for pacing)."""
+        n_scan = min(cfg.n_layers, _scan_stop(_hook_layers(cfg, tuple(hook_points))))
+        return n_models * max(1, -(-n_scan // cls.SEG_LAYERS))
+
+    def step(self) -> bool:
+        """Dispatch the next quantum; False once fully dispatched."""
+        if self._out is not None:
+            return False
+        if self._resid is None:
+            self._resid, self._buf = _seg_start_impl(
+                self.params_seq[self._model_idx], self.tokens, self.cfg,
+                len(self.capture),
+            )
+        if self._lo < self.n_scan:
+            k = min(self.SEG_LAYERS, self.n_scan - self._lo)
+            self._resid, self._buf = _seg_scan_impl(
+                self.params_seq[self._model_idx], self._resid, self._buf,
+                jnp.int32(self._lo), self.cfg, self.capture, k,
+            )
+            self._lo += k
+        if self._lo >= self.n_scan:
+            self._done_resids.append(self._resid)
+            self._done_bufs.append(self._buf)
+            self._resid = self._buf = None
+            self._lo = 0
+            self._model_idx += 1
+            if self._model_idx == len(self.params_seq):
+                self._out = _seg_finish_impl(
+                    tuple(self._done_resids), tuple(self._done_bufs),
+                    self.cfg, self.capture, self.n_scan, self.out_dtype,
+                )
+                self._done_resids = self._done_bufs = []
+                return False
+        return True
+
+    def result(self) -> jax.Array:
+        while self._out is None:
+            self.step()
+        return self._out
+
+
+# ---------------------------------------------------------------------------
 # tensor-parallel harvest (models too big for one chip's HBM)
 
 

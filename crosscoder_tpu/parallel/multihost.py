@@ -29,10 +29,11 @@ multi-process runs (violations deadlock cross-host rendezvous):
 
 - the trainer's prefetch worker is disabled (its serve gather would race
   the main thread's step differently per host) — ``Trainer.__init__``;
-- the buffer's opportunistic ``is_ready()`` drains are skipped (host-local
-  timing must not decide when a collective scatter is dispatched) —
-  ``_advance_cycle``; the depth-bound and trigger-point drains are
-  deterministic and do all the landing.
+- the buffer's refill dispatch/drain schedule derives ONLY from
+  host-replicated state (serve pointer, write offsets, the per-serve
+  dispatch credit — ``_advance_cycle``/``_head_drainable``), never from
+  host-local timing, so every process dispatches the same harvest
+  segments and collective scatters in the same order.
 """
 
 from __future__ import annotations

@@ -47,7 +47,8 @@ from crosscoder_tpu.utils.logging import MetricsLogger, source_tag
 
 
 def make_train_step(
-    cfg: CrossCoderConfig, mesh, tx, state_shardings, with_metrics: bool = True
+    cfg: CrossCoderConfig, mesh, tx, state_shardings, with_metrics: bool = True,
+    aux_on: bool = True,
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the compiled train step for a given mesh/optimizer.
 
@@ -69,7 +70,13 @@ def make_train_step(
         )
     lr_fn = schedules.lr_schedule(cfg)
     l1_fn = schedules.l1_coeff_schedule(cfg)
-    loss_fn = functools.partial(cc.training_loss, cfg=cfg, with_metrics=with_metrics)
+    # fired-tracking runs on EVERY aux-enabled step; the aux loss itself
+    # only on aux_on steps (``cfg.aux_every`` amortization — the Trainer
+    # compiles both variants and alternates)
+    loss_fn = functools.partial(
+        cc.training_loss, cfg=cfg, with_metrics=with_metrics,
+        track_fired=cfg.aux_k > 0,
+    )
     if cfg.remat:
         loss_fn = jax.checkpoint(loss_fn)
 
@@ -90,10 +97,14 @@ def make_train_step(
             # are "dead"; the aux loss reconstructs the step's residual
             # with the top aux_k of them. Same warmup ramp as the other
             # sparsity terms (and naturally inert for the first
-            # aux_dead_steps — nothing can be dead yet).
+            # aux_dead_steps — nothing can be dead yet). ``aux_on=False``
+            # (the off-steps of cfg.aux_every amortization) keeps the
+            # deadness metric and fired-tracking but compiles the aux
+            # ranking+decode out entirely.
             dead = state.aux["steps_since_fired"] >= cfg.aux_dead_steps
-            kwargs["dead_mask"] = dead
-            kwargs["aux_coeff"] = cfg.aux_k_coeff * warm_fn(state.step)
+            if aux_on:
+                kwargs["dead_mask"] = dead
+                kwargs["aux_coeff"] = cfg.aux_k_coeff * warm_fn(state.step)
         (loss, losses), grads = grad_fn(state.params, x, l1_coeff, **kwargs)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -112,7 +123,8 @@ def make_train_step(
                 )
             }
             metrics["dead_frac"] = jnp.mean(dead.astype(jnp.float32))
-            metrics["aux_loss"] = losses.aux_loss
+            if aux_on:
+                metrics["aux_loss"] = losses.aux_loss
         if with_metrics:
             metrics["l0_loss"] = losses.l0_loss
             metrics["explained_variance"] = jnp.mean(losses.explained_variance)
@@ -185,8 +197,14 @@ class Trainer:
         state = init_train_state(jax.random.key(cfg.seed), cfg, tx)
         self._state_shardings = mesh_lib.state_shardings(self.mesh, state, cfg.shard_sources)
         self.state = jax.device_put(state, self._state_shardings)
-        self._step_fn = make_train_step(cfg, self.mesh, tx, self._state_shardings)
-        self._step_fn_bare = None   # compiled on first off-log-step use
+        # compiled step variants, keyed (with_metrics, aux_on); built lazily
+        # except the default. aux_on alternates per cfg.aux_every (AuxK
+        # amortization); the host-side step mirror picks the variant without
+        # a device sync.
+        self._step_fns: dict[tuple[bool, bool], Callable] = {
+            (True, True): make_train_step(cfg, self.mesh, tx, self._state_shardings)
+        }
+        self._host_step = 0
         self._batch_sharding = mesh_lib.batch_sharding(self.mesh)
         # device-resident per-source scale for the raw-bf16 serve path; ones
         # when the source already serves normalized fp32 (synthetic, tests)
@@ -233,6 +251,9 @@ class Trainer:
         self._drain_prefetch()
         state, meta = self.checkpointer.restore(self.cfg, self._tx, version_dir, save)
         self.state = jax.device_put(state, self._state_shardings)
+        # host mirror of the device step counter (aux_every variant choice
+        # without a per-step sync); one sync here at restore is fine
+        self._host_step = int(self.state.step)
         if "buffer" in meta and hasattr(self.buffer, "load_state_dict"):
             # the stream rewinds to the checkpoint position — the prefetched
             # batch belongs to the abandoned position; now it is stale
@@ -343,18 +364,21 @@ class Trainer:
         ~13% of the step on TPU) are compiled out and absent from the
         returned dict. ``train()`` uses it off log-steps.
         """
-        if full_metrics:
-            fn = self._step_fn
-        else:
-            if self._step_fn_bare is None:
-                self._step_fn_bare = make_train_step(
-                    self.cfg, self.mesh, self._tx, self._state_shardings,
-                    with_metrics=False,
-                )
-            fn = self._step_fn_bare
+        cfg = self.cfg
+        # aux_on=True is the canonical variant when AuxK is off or per-step
+        aux_on = (cfg.aux_k == 0 or cfg.aux_every <= 1
+                  or self._host_step % cfg.aux_every == 0)
+        key = (full_metrics, aux_on)
+        fn = self._step_fns.get(key)
+        if fn is None:
+            fn = self._step_fns[key] = make_train_step(
+                cfg, self.mesh, self._tx, self._state_shardings,
+                with_metrics=full_metrics, aux_on=aux_on,
+            )
         batch, scale = self._next_batch()
         with self._dispatch_lock:
             self.state, metrics = fn(self.state, batch, scale)
+        self._host_step += 1
         return metrics
 
     def log(self, metrics: dict[str, Any], step: int) -> None:
@@ -377,13 +401,22 @@ class Trainer:
         """
         if not clean:
             return False
-        # import/lookup OUTSIDE the try: jax._src is a private namespace,
-        # and an ImportError after a jax upgrade must fail loudly here, not
-        # masquerade as a barrier timeout that silently skips every final
-        # multi-host checkpoint
-        from jax._src import distributed
-
-        client = distributed.global_state.client
+        # jax._src is a private namespace: a jax upgrade can move it. That
+        # must degrade to "skip the final save, periodic saves already
+        # landed" with a loud warning — not an ImportError out of train()'s
+        # finally block that turns an otherwise clean run into a failure.
+        # Import failure is detected SEPARATELY from the barrier try below
+        # so a missing client is never mistaken for a barrier timeout.
+        try:
+            from jax._src import distributed
+            client = distributed.global_state.client
+        except (ImportError, AttributeError) as e:
+            print(f"[crosscoder_tpu] coordination-service client lookup "
+                  f"failed ({type(e).__name__}: {e}); this jax version moved "
+                  f"the private jax._src.distributed path — skipping the "
+                  f"final collective save (periodic saves already landed)",
+                  flush=True)
+            return False
         if client is None:
             # no coordination client on a multi-process mesh (should not
             # happen — multihost.initialize creates one): any agreement
@@ -471,7 +504,7 @@ class Trainer:
                   "writing checkpoint", flush=True)
 
         multi_process = jax.process_count() > 1
-        poll_every = max(1, int(self.cfg.stop_poll_every))
+        poll_every = int(self.cfg.stop_poll_every)  # validated >= 1 in config
 
         def _stop_agreed(i: int) -> bool:
             # Checkpointer.save is a COLLECTIVE on a multi-host mesh, so the

@@ -81,20 +81,20 @@ def test_feature_acts_match_direct_encode(dash_setup):
     assert data.features[0].frac_active == pytest.approx(float((f > 0).mean()), abs=1e-9)
 
 
-def test_quantile_interval_groups(dash_setup):
+def test_interval_groups(dash_setup):
     """sae_vis-parity interval groups (nb:cells 36-42; round-3 VERDICT R14):
     sequences sampled from equal value-bands of (0, max_act], disjoint from
     the top-k group, each entry's peak inside its band."""
     lm_cfg, params, cfg, cc_params, tokens = dash_setup
     vis_cfg = FeatureVisConfig(hook_point=HP, features=(0, 5),
-                               top_k_sequences=2, n_quantile_groups=3,
+                               top_k_sequences=2, n_interval_groups=3,
                                seqs_per_group=2)
     data = FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_cfg)
     for fd in data.features:
         if fd.max_act <= 0:
             continue
-        assert len(fd.quantile_groups) <= 3
-        for grp in fd.quantile_groups:
+        assert len(fd.interval_groups) <= 3
+        for grp in fd.interval_groups:
             assert grp["lo"] < grp["hi"] <= fd.max_act + 1e-6
             assert 1 <= len(grp["seqs"]) <= 2
             for seq in grp["seqs"]:
@@ -103,18 +103,18 @@ def test_quantile_interval_groups(dash_setup):
                 assert peak_val <= grp["hi"] + 1e-6
 
     # off switch
-    vis_off = FeatureVisConfig(hook_point=HP, features=(0,), n_quantile_groups=0)
+    vis_off = FeatureVisConfig(hook_point=HP, features=(0,), n_interval_groups=0)
     d2 = FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_off)
-    assert d2.features[0].quantile_groups == []
+    assert d2.features[0].interval_groups == []
 
 
-def test_quantile_groups_in_html(dash_setup, tmp_path):
+def test_interval_groups_in_html(dash_setup, tmp_path):
     lm_cfg, params, cfg, cc_params, tokens = dash_setup
-    vis_cfg = FeatureVisConfig(hook_point=HP, features=(0, 5), n_quantile_groups=3)
+    vis_cfg = FeatureVisConfig(hook_point=HP, features=(0, 5), n_interval_groups=3)
     data = FeatureVisData.create(cc_params, cfg, lm_cfg, params, tokens, vis_cfg)
     doc = data.save_feature_centric_vis(tmp_path / "g.html").read_text()
     assert "top activations" in doc
-    if any(fd.quantile_groups for fd in data.features):
+    if any(fd.interval_groups for fd in data.features):
         assert "interval " in doc
 
 

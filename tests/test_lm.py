@@ -381,3 +381,38 @@ def test_gemma2_family_named_configs():
     assert c27.head_dim == 128 and c27.n_heads == 32
     assert lm.config_for("gemma-2-27b-it") == c27
     assert lm.config_for("gemma-2-9b").query_pre_attn_scalar == 256.0
+
+
+def test_segmented_harvest_matches_monolithic():
+    """SegmentedHarvest (the refill pipeline's sub-forward dispatch quanta)
+    computes the same stacked capture as run_with_cache_multi — same per-layer
+    op sequence, only the scan is cut into sub-scans. Covers mixed sublayer
+    sites, a ragged final segment (n_scan % SEG_LAYERS != 0), and the
+    pacing count contract."""
+    cfg = lm.LMConfig.tiny()
+    pa = lm.init_params(jax.random.key(11), cfg)
+    pb = lm.init_params(jax.random.key(12), cfg)
+    tokens = jax.numpy.asarray(
+        np.random.default_rng(7).integers(0, cfg.vocab_size, size=(2, 12))
+    )
+    for hooks in (
+        ("blocks.2.hook_resid_pre",),
+        # mixed sites + multi-layer: n_scan = 4 → ranges (3, 1) at SEG_LAYERS=3
+        ("blocks.1.hook_resid_pre", "blocks.3.hook_attn_out",
+         "blocks.2.hook_mlp_out"),
+    ):
+        want = lm.run_with_cache_multi([pa, pb], tokens, cfg, hooks)
+        job = lm.SegmentedHarvest([pa, pb], tokens, cfg, hooks)
+        steps = 0
+        while job.step():
+            steps += 1
+        assert steps + 1 == job.n_steps == lm.SegmentedHarvest.count(cfg, hooks, 2)
+        np.testing.assert_allclose(
+            np.asarray(job.result(), np.float32), np.asarray(want, np.float32),
+            rtol=1e-5, atol=1e-5,
+        )
+        # result() after completion is idempotent; out_dtype is honored
+        assert job.result() is job.result()
+    job = lm.SegmentedHarvest([pa], tokens, cfg, ("blocks.1.hook_resid_pre",),
+                              out_dtype=jax.numpy.bfloat16)
+    assert job.result().dtype == jax.numpy.bfloat16
