@@ -1,0 +1,104 @@
+"""Round-5 probe 2: gather/scatter decode costs, measured without DCE traps.
+
+Decides whether the factored TopK decode uses XLA's take or needs a Pallas
+embedding-style gather. Times, at dict 2^15/2^16/2^17 (B=4096, k=32,
+nd=4608, bf16):
+
+- take_fwd:    jnp.take(W_dec, idx) + einsum bk,bkd->bd   (factored decode)
+- take_jvpgrad: value_and_grad of (sum of factored decode) wrt vals AND
+               W_dec — XLA's own backward, the real training cost
+- dvals_gather: einsum bd,bkd->bk with gathered rows     (df replacement)
+- dense pair:  f @ W_dec and g @ W_dec.T                 (what they replace)
+
+Each timed op's full output feeds a reduction consumed by the carry.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+B, K, ND = 4096, 32, 2 * 2304
+
+
+def timeit(fn, *args, n=20, warmup=1):
+    @jax.jit
+    def chained(*a):
+        def body(i, x):
+            r = fn(x, *a[1:])
+            bump = sum(
+                jnp.sum(leaf.astype(jnp.float32))
+                for leaf in jax.tree_util.tree_leaves(r)
+            ) * 1e-30
+            return x + bump.astype(x.dtype)
+        return jax.lax.fori_loop(0, n, body, a[0])
+
+    for _ in range(warmup):
+        r = chained(*args)
+    float(jax.device_get(r.reshape(-1)[0]).astype(jnp.float32))
+    t0 = time.perf_counter()
+    r = chained(*args)
+    float(jax.device_get(r.reshape(-1)[0]).astype(jnp.float32))
+    return round(1000 * (time.perf_counter() - t0) / n, 3)
+
+
+def probe(H: int) -> dict:
+    out: dict = {"dict_size": H}
+    x = jax.random.normal(jax.random.key(1), (B, ND), jnp.bfloat16)
+    W_enc = jax.random.normal(jax.random.key(0), (ND, H), jnp.bfloat16) * 0.02
+    W_dec = jax.random.normal(jax.random.key(2), (H, ND), jnp.bfloat16) * 0.02
+    hp = jax.nn.relu(x @ W_enc)
+    g = jax.random.normal(jax.random.key(3), (B, ND), jnp.bfloat16)
+    vals, idx = jax.jit(lambda h: jax.lax.top_k(h, K))(hp)
+    vals = jax.block_until_ready(vals)
+
+    def take_fwd(vals, idx, W):
+        w = jnp.take(W, idx, axis=0)
+        return jnp.einsum("bk,bkd->bd", vals, w)
+
+    out["take_fwd"] = timeit(take_fwd, vals, idx, W_dec)
+
+    def take_loss(vals, idx, W, g):
+        return jnp.sum(take_fwd(vals, idx, W).astype(jnp.float32) *
+                       g.astype(jnp.float32))
+
+    def take_grad(vals, idx, W, g):
+        return jax.grad(take_loss, argnums=(0, 2))(vals, idx, W, g)
+
+    out["take_fwd_plus_grads"] = timeit(take_grad, vals, idx, W_dec, g)
+
+    def dvals_gather(g, idx, W):
+        w = jnp.take(W, idx, axis=0)
+        return jnp.einsum("bd,bkd->bk", g, w)
+
+    out["dvals_gather"] = timeit(dvals_gather, g, idx, W_dec)
+
+    # gather only (no einsum): isolates DMA efficiency of 131k 9KB rows
+    out["take_only"] = timeit(lambda v, idx, W: jnp.take(W, idx, axis=0) * v[..., None],
+                              vals, idx, W_dec)
+
+    f = jax.jit(lambda v, i: jnp.zeros((B, H), v.dtype).at[
+        jnp.arange(B)[:, None], i].set(v, mode="drop", unique_indices=True))(vals, idx)
+    out["dense_dec"] = timeit(lambda f, W: f @ W, f, W_dec)
+    out["dense_df"] = timeit(lambda g, W: g @ W.T, g, W_dec)
+
+    def scatter_bk(vals, idx):
+        rows = jnp.arange(B)[:, None]
+        return jnp.zeros((B, H), vals.dtype).at[rows, idx].set(
+            vals, mode="drop", unique_indices=True)
+
+    out["scatterBk"] = timeit(scatter_bk, vals, idx)
+    return out
+
+
+def main():
+    res = [probe(H) for H in (2**15, 2**16, 2**17)]
+    with open("artifacts/GATHER_PROBE_r05.json", "w") as fh:
+        json.dump(res, fh, indent=1)
+    print(json.dumps(res, indent=1))
+
+
+if __name__ == "__main__":
+    main()

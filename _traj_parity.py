@@ -1,16 +1,20 @@
-"""Quantitative torch↔JAX trajectory parity (round-3 VERDICT next #6).
+"""Quantitative torch↔JAX trajectory parity (round-3 VERDICT next #6;
+round-4 weak #6: extend to the sparse tier).
 
-The 38-step rig (tests/test_backends.py) crosses only the lr-decay
-boundary at toy shape. This runs BOTH engines for 2000 steps at dict 4096
-with IDENTICAL init (the jax init is copied into the torch tensors
-in-place, so divergence measures accumulated numerics drift, not sampler
-noise), identical synthetic data streams, crossing the L1-warmup boundary
-(step 100 at l1_warmup_frac=0.05) and the lr-decay start (step 1600), and
-records the max relative loss divergence as an artifact.
+Arms (each: BOTH engines, IDENTICAL init — the jax draw is copied into
+the torch tensors in-place — and identical synthetic streams):
+
+- relu: 2000 steps at dict 4096, crossing the L1-warmup boundary
+  (step 100 at l1_warmup_frac=0.05) and the lr-decay start (step 1600).
+- topk: 2000 steps, TopK(k=32) straight-through, l1_coeff=0 — the
+  configuration the benchmarks headline.
+- topk_auxk: 1000 steps with AuxK engaged (aux_dead_steps small so the
+  dead set is non-empty early) and EXACT aux ranking on both engines
+  (cfg.aux_exact_rank), so the same latents receive aux gradient.
 
 Runs on CPU (torch has no TPU here; both engines in fp32):
     python _traj_parity.py          # TP_STEPS=2000 default
-Writes artifacts/TRAJ_PARITY_r04.json.
+Writes artifacts/TRAJ_PARITY_r05.json.
 """
 
 from __future__ import annotations
@@ -21,65 +25,57 @@ import time
 from pathlib import Path
 
 
-def main() -> None:
+def run_arm(label: str, cfg, steps: int, control_eps: float = 0.0) -> dict:
+    """torch-vs-jax by default; ``control_eps > 0`` instead runs JAX
+    against ITSELF with the init perturbed by a relative eps — the
+    Lyapunov control that calibrates how much divergence the system's own
+    chaos produces from a 1-ulp difference, independent of any engine
+    discrepancy (TopK's discrete support selection amplifies last-ulp
+    pre-act differences into different gradient sparsity patterns)."""
     import jax
-    jax.config.update("jax_platforms", "cpu")
     import numpy as np
-    import torch
 
-    from crosscoder_tpu.config import CrossCoderConfig
     from crosscoder_tpu.data.synthetic import SyntheticActivationSource
     from crosscoder_tpu.train.torch_backend import make_trainer
 
-    steps = int(os.environ.get("TP_STEPS", 2000))
-    cfg = CrossCoderConfig(
-        d_in=32, dict_size=4096, batch_size=64, num_tokens=64 * steps,
-        lr=1e-3, l1_coeff=1.0, enc_dtype="fp32", log_backend="null", seed=11,
-    )
-    warmup_end = int(cfg.l1_warmup_frac * cfg.total_steps)
-    decay_start = int((1 - cfg.lr_decay_frac) * cfg.total_steps)
-
     tj = make_trainer(cfg, "jax", buffer=SyntheticActivationSource(cfg))
-    tt = make_trainer(cfg, "torch", buffer=SyntheticActivationSource(cfg))
-    # identical init: jax's draw becomes the torch tensors' values in-place
-    # (the Adam optimizer already references these tensors)
-    jp = jax.device_get(tj.state.params)
-    with torch.no_grad():
-        for k, v in tt.params.items():
-            v.copy_(torch.from_numpy(np.asarray(jp[k], np.float32)))
+    if control_eps > 0:
+        tt = make_trainer(cfg, "jax", buffer=SyntheticActivationSource(cfg))
+        tt.state = tt.state._replace(params={
+            k: v * (1.0 + control_eps) for k, v in tt.state.params.items()
+        })
+        def t_step():
+            return float(jax.device_get(tt.step()["loss"]))
+    else:
+        import torch
+
+        tt = make_trainer(cfg, "torch", buffer=SyntheticActivationSource(cfg))
+        jp = jax.device_get(tj.state.params)
+        with torch.no_grad():
+            for k, v in tt.params.items():
+                v.copy_(torch.from_numpy(np.array(jp[k], np.float32, copy=True)))
+        def t_step():
+            return tt.step()["loss"]
 
     lj, lt = [], []
     t0 = time.perf_counter()
     for i in range(steps):
-        mj = tj.step()
-        lj.append(float(jax.device_get(mj["loss"])))
-        lt.append(tt.step()["loss"])
+        lj.append(float(jax.device_get(tj.step()["loss"])))
+        lt.append(t_step())
         if (i + 1) % 200 == 0:
-            print(f"step {i+1}: jax={lj[-1]:.5f} torch={lt[-1]:.5f} "
+            print(f"[{label}] step {i+1}: a={lj[-1]:.5f} b={lt[-1]:.5f} "
                   f"rel={(lj[-1]-lt[-1])/lt[-1]:+.2e}", flush=True)
     wall = time.perf_counter() - t0
     tj.close()
+    if control_eps > 0:
+        tt.close()
 
     a, b = np.asarray(lj), np.asarray(lt)
     rel = np.abs(a - b) / np.maximum(np.abs(b), 1e-9)
-
-    def seg(lo, hi):
-        r = rel[lo:hi]
-        return {"max_rel": float(r.max()), "mean_rel": float(r.mean()),
-                "steps": [lo, hi]}
-
-    out = {
-        "steps": steps, "dict_size": cfg.dict_size, "d_in": cfg.d_in,
-        "batch_size": cfg.batch_size, "identical_init": True,
-        "l1_warmup_end_step": warmup_end, "lr_decay_start_step": decay_start,
-        "wall_s": round(wall, 1),
+    return {
+        "steps": steps, "wall_s": round(wall, 1),
         "max_rel_divergence": float(rel.max()),
         "max_rel_divergence_after_step10": float(rel[10:].max()),
-        "segments": {
-            "warmup(0..{})".format(warmup_end): seg(0, warmup_end),
-            "plateau": seg(warmup_end, decay_start),
-            "decay": seg(decay_start, steps),
-        },
         "final_loss": {"jax": float(a[-1]), "torch": float(b[-1])},
         "curve_every_50": [
             {"step": i, "jax": float(a[i]), "torch": float(b[i]),
@@ -87,12 +83,67 @@ def main() -> None:
             for i in range(0, steps, 50)
         ],
     }
-    p = Path("artifacts/TRAJ_PARITY_r04.json")
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from crosscoder_tpu.config import CrossCoderConfig
+
+    steps = int(os.environ.get("TP_STEPS", 2000))
+    base = dict(
+        d_in=32, dict_size=4096, batch_size=64,
+        lr=1e-3, enc_dtype="fp32", log_backend="null", seed=11,
+    )
+    arms = {
+        "relu": (CrossCoderConfig(**base, l1_coeff=1.0,
+                                  num_tokens=64 * steps), steps),
+        "topk": (CrossCoderConfig(**base, activation="topk", topk_k=32,
+                                  l1_coeff=0.0, num_tokens=64 * steps), steps),
+        "topk_auxk": (CrossCoderConfig(
+            **base, activation="topk", topk_k=32, l1_coeff=0.0,
+            aux_k=64, aux_dead_steps=25, aux_exact_rank=True,
+            num_tokens=64 * (steps // 2)), steps // 2),
+    }
+    # Lyapunov control: jax vs jax with a 1e-7-relative init perturbation,
+    # same TopK config — the divergence floor the system's own sensitivity
+    # sets for ANY two fp-differing executions
+    arms["topk_control_eps"] = (arms["topk"][0], steps, 1e-7)
+
+    def arm_fingerprint(cfg, n, eps):
+        return {"activation": cfg.activation, "l1_coeff": cfg.l1_coeff,
+                "aux_k": cfg.aux_k, "aux_dead_steps": cfg.aux_dead_steps,
+                "dict_size": cfg.dict_size, "control_eps": eps, "steps": n}
+
+    out: dict = {"identical_init": True, "arms": {}}
+    p = Path("artifacts/TRAJ_PARITY_r05.json")
+    prev_arms = {}
+    if p.exists():
+        prev_arms = json.loads(p.read_text()).get("arms", {})
+    for label, spec in arms.items():
+        cfg, n = spec[0], spec[1]
+        eps = spec[2] if len(spec) > 2 else 0.0
+        fp = arm_fingerprint(cfg, n, eps)
+        prev = prev_arms.get(label)
+        # reuse a finished arm only when its FULL config fingerprint
+        # matches — a step count alone would silently keep stale results
+        # after an arm's config is edited
+        if prev is not None and prev.get("config") == fp:
+            print(f"[{label}] reusing finished arm (config match)", flush=True)
+            out["arms"][label] = prev
+            continue
+        out["arms"][label] = run_arm(label, cfg, n, control_eps=eps)
+        out["arms"][label]["config"] = fp
+
+    p = Path("artifacts/TRAJ_PARITY_r05.json")
     p.parent.mkdir(exist_ok=True)
     p.write_text(json.dumps(out, indent=1))
-    summary = {k: out[k] for k in ("max_rel_divergence",
-                                   "max_rel_divergence_after_step10",
-                                   "final_loss", "wall_s")}
+    summary = {
+        label: {"max_rel": arm["max_rel_divergence"],
+                "final": arm["final_loss"]}
+        for label, arm in out["arms"].items()
+    }
     print(json.dumps(summary, indent=1), flush=True)
     print(f"wrote {p}", flush=True)
 

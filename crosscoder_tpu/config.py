@@ -82,6 +82,16 @@ class CrossCoderConfig:
     sparse_decode: bool = False     # topk only: decode via the k active rows
                                     # (gather + custom-vjp) instead of the
                                     # dense [B,H]x[H,n,d] matmul
+    factored_decode: str = "auto"   # topk + Pallas tier: decode FORWARD
+                                    # through the k active rows (sparsify
+                                    # kernel + gather), backward through
+                                    # the same dense matmuls as the dense
+                                    # path. "auto" = on for dict >= 2^17
+                                    # (measured v5e crossover vs the dense
+                                    # matmul: -8 ms at 2^17, +6 ms at
+                                    # 2^16); "on"/"off" force. Requires
+                                    # l1_coeff == 0 (see
+                                    # models.crosscoder._factored_topk_forward)
     jumprelu_theta: float = 0.001   # initial JumpReLU threshold
     jumprelu_bandwidth: float = 0.001  # STE bandwidth for the threshold gradient
     l0_coeff: float = 0.0           # jumprelu only: coefficient on the
@@ -109,6 +119,13 @@ class CrossCoderConfig:
     aux_dead_steps: int = 500       # a latent is "dead" after this many
                                     # consecutive steps without firing
                                     # (500 steps x batch 4096 ≈ 2M rows)
+    aux_exact_rank: bool = False    # rank dead latents with exact top_k
+                                    # instead of approx_max_k. Slow (the
+                                    # exact [B,H] sort costs more than the
+                                    # rest of the step at dict 2^15) —
+                                    # engine-parity runs only, where the
+                                    # torch oracle's exact ranking must
+                                    # select identical aux latents
     aux_every: int = 1              # run the aux ranking+decode every Nth
                                     # step (fired-tracking stays per-step,
                                     # so deadness is always current). The
@@ -119,6 +136,18 @@ class CrossCoderConfig:
                                     # et al. recipe. Quality under
                                     # amortization: artifacts/
                                     # ACT_QUALITY_r05.json.
+    resample_every: int = 0         # >0: dead-latent RESAMPLING every Nth
+                                    # step (Bricken et al. 2023's neuron
+                                    # resampling, the alternative to AuxK):
+                                    # dead latents' decoder rows re-init
+                                    # from high-residual batch examples,
+                                    # encoder rows aligned and downscaled,
+                                    # b_enc zeroed, Adam moments reset.
+                                    # Deadness = steps_since_fired >=
+                                    # resample_dead_steps. Composes with
+                                    # aux_k (either or both).
+    resample_dead_steps: int = 0    # deadness threshold for resampling;
+                                    # 0 = inherit aux_dead_steps
     batchtopk_threshold: float = 0.0   # >0: batchtopk EVAL mode — a fixed
                                     # global threshold (from
                                     # crosscoder.calibrate_batchtopk_threshold)
@@ -248,6 +277,21 @@ class CrossCoderConfig:
             raise ValueError(
                 f"sparse_decode requires activation='topk', got {self.activation!r}"
             )
+        if self.factored_decode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"factored_decode must be auto|on|off, got {self.factored_decode!r}"
+            )
+        if self.factored_decode == "on" and self.activation != "topk":
+            raise ValueError(
+                f"factored_decode='on' requires activation='topk', "
+                f"got {self.activation!r}"
+            )
+        if self.factored_decode == "on" and self.l1_coeff != 0:
+            raise ValueError(
+                "factored_decode='on' requires l1_coeff=0: the factored "
+                "forward's custom VJP carries no gradient path through "
+                "(vals, idx), which a nonzero weighted-L1 objective needs"
+            )
         if self.l0_coeff > 0 and self.activation != "jumprelu":
             raise ValueError(
                 f"l0_coeff requires activation='jumprelu' (the rectangle-"
@@ -268,6 +312,16 @@ class CrossCoderConfig:
             raise ValueError("aux_dead_steps must be >= 1 when aux_k > 0")
         if self.aux_every < 1:
             raise ValueError(f"aux_every must be >= 1, got {self.aux_every}")
+        if self.resample_every < 0 or self.resample_dead_steps < 0:
+            raise ValueError(
+                f"resample_every/resample_dead_steps must be >= 0, got "
+                f"{self.resample_every}/{self.resample_dead_steps}"
+            )
+        if self.resample_every > 0 and self.resample_threshold_steps < 1:
+            raise ValueError(
+                "resampling needs a deadness threshold: set "
+                "resample_dead_steps (or aux_dead_steps) >= 1"
+            )
         if self.stop_poll_every < 1:
             raise ValueError(
                 f"stop_poll_every must be >= 1, got {self.stop_poll_every}"
@@ -278,6 +332,12 @@ class CrossCoderConfig:
     def total_steps(self) -> int:
         """Optimizer steps for the token budget (reference trainer.py:14)."""
         return self.num_tokens // self.batch_size
+
+    @property
+    def resample_threshold_steps(self) -> int:
+        """Deadness threshold for resampling (resample_dead_steps, falling
+        back to aux_dead_steps)."""
+        return self.resample_dead_steps or self.aux_dead_steps
 
     @property
     def n_layers_hooked(self) -> int:

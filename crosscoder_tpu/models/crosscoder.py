@@ -35,6 +35,7 @@ Design notes (why this is not a torch translation):
 
 from __future__ import annotations
 
+import functools
 from typing import Any, NamedTuple
 
 import jax
@@ -260,6 +261,108 @@ def _sparse_decode_bwd(res, g):
 _sparse_decode_product.defvjp(_sparse_decode_fwd, _sparse_decode_bwd)
 
 
+# ---------------------------------------------------------------------------
+# factored TopK decode (Pallas tier, round-5): forward through the k active
+# rows only, backward through the SAME dense matmuls as the dense path.
+#
+# Why this split (all numbers v5e, B=4096, k=32, artifacts/TOPK_PROBE_r05 +
+# GATHER_PROBE_r05): the decode FORWARD is the only dense matmul sparsity
+# can actually remove — jnp.take of the k active W_dec rows + a [B,k,n,d]
+# einsum costs 5.7-16 ms vs the 20-33 ms dense matmul at dict >= 2^16. The
+# BACKWARD stays dense on purpose: a factored df (gather 8-16 ms + the
+# [B,k]->[B,H] scatter 6-20 ms) loses to the dense matmul+mask at every
+# size, and XLA's own scatter-add gradient for a gathered W_dec costs
+# 42-76 ms. Gradients are therefore numerically IDENTICAL to the dense
+# path (same matmuls, same straight-through mask) while the forward saves
+# ~27 ms at 2^17. (vals, idx) come from the sparsify drain kernel — every
+# general extractor measured is slower: lax.top_k 25-63 ms, approx_max_k
+# inexact per row (79-97%), XLA scatter-compaction touches all B*H pairs.
+# No reference counterpart (the reference decode is always dense,
+# reference crosscoder.py:82-89).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _factored_topk_forward(
+    h: jax.Array, W_dec: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """``(recon [B,n,d] f32 (no b_dec), vals [B,k], idx [B,k])`` from
+    pre-acts ``h [B,H]``.
+
+    Differentiable in ``h`` (straight-through mask) and ``W_dec`` (dense
+    matmul), exactly as the dense TopK path. ``vals``/``idx`` carry NO
+    gradient path — cotangents on them are ignored, which is only sound
+    when nothing differentiable consumes them (the dispatch in get_losses
+    guarantees l1_coeff == 0 on this path; metric-only uses are fine).
+    """
+    from crosscoder_tpu.ops import topk_pallas
+
+    f = topk_pallas.topk(h, k)
+    vals, idx = topk_pallas.sparsify(f, k)
+    w = jnp.take(W_dec, idx, axis=0)                       # [B, k, n, d]
+    recon = jnp.einsum("bk,bknd->bnd", vals, w, preferred_element_type=jnp.float32)
+    return recon, vals, idx
+
+
+def _factored_topk_fwd(h, W_dec, k):
+    from crosscoder_tpu.ops import topk_pallas
+
+    f = topk_pallas.topk(h, k)
+    vals, idx = topk_pallas.sparsify(f, k)
+    w = jnp.take(W_dec, idx, axis=0)                       # [B, k, n, d]
+    recon = jnp.einsum("bk,bknd->bnd", vals, w, preferred_element_type=jnp.float32)
+    # f is the residual: both backward matmuls consume the masked [B,H]
+    # activations (dW_dec contraction + the straight-through mask on df)
+    return (recon, vals, idx), (f, W_dec)
+
+
+def _factored_topk_bwd(k, res, g):
+    f, W_dec = res
+    g_recon = g[0].astype(jnp.float32)                     # [B, n, d]
+    # cotangents g[1], g[2] (vals, idx) are ignored — see docstring
+    dW_dec = jnp.einsum(
+        "bh,bnd->hnd", f.astype(jnp.float32), g_recon,
+        preferred_element_type=jnp.float32,
+    ).astype(W_dec.dtype)
+    df = jnp.einsum(
+        "bnd,hnd->bh", g_recon, W_dec.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    dh = jnp.where(f > 0, df, 0.0).astype(f.dtype)
+    return dh, dW_dec
+
+
+_factored_topk_forward.defvjp(_factored_topk_fwd, _factored_topk_bwd)
+
+
+def use_factored_decode(cfg: CrossCoderConfig) -> bool:
+    """Dispatch for the factored TopK decode tier.
+
+    ``cfg.factored_decode``: "off" never; "on" whenever sound+supported;
+    "auto" additionally requires dict_size >= 2^17 — the XLA row gather
+    costs ~17-20 ms flat (131k x 9 KB rows is instruction-rate-bound on
+    v5e, ~74 GB/s effective), so it only beats the dense decode matmul
+    once that matmul crosses ~30 ms (dict 2^17 at bench shapes; measured
+    A/B: -8 ms at 2^17, +6 ms at 2^16).
+    Soundness gate: l1_coeff must be 0 (see _factored_topk_forward).
+    """
+    if cfg.activation != "topk" or cfg.sparse_decode:
+        return False
+    mode = cfg.factored_decode
+    if mode == "off" or cfg.l1_coeff != 0:
+        return False
+    from crosscoder_tpu.ops import activations as act_ops
+    from crosscoder_tpu.ops import topk_pallas
+
+    if not act_ops._default_use_pallas() and not topk_pallas._INTERPRET:
+        return False
+    probe = jax.ShapeDtypeStruct((1, cfg.dict_size), dtype_of(cfg.enc_dtype))
+    if not topk_pallas.supported(probe, cfg.topk_k):
+        return False
+    if not topk_pallas.sparsify_supported(cfg.dict_size, cfg.topk_k):
+        return False
+    return mode == "on" or cfg.dict_size >= 131072
+
+
 def topk_vals_idx(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> tuple[jax.Array, jax.Array]:
     """TopK encode in factored form: ``(vals [B,k], idx [B,k])``.
 
@@ -313,13 +416,23 @@ def get_losses(
     - ``l0``: mean count of strictly-positive latents
     """
     x = x.astype(dtype_of(cfg.enc_dtype))
-    sparse = cfg.sparse_decode and cfg.activation == "topk"
+    factored = use_factored_decode(cfg)
+    sparse = (cfg.sparse_decode and cfg.activation == "topk") or factored
     l0_penalty: jax.Array | float = 0.0
     h = None            # pre-acts, kept when a later consumer (the
                         # JumpReLU L0 penalty, the AuxK ranking) needs
                         # them — shared explicitly rather than trusting
                         # CSE to dedupe a second encode matmul
-    if sparse:
+    if factored:
+        # Pallas factored tier: kernel mask → sparsify → k-row decode;
+        # backward identical to the dense path (see _factored_topk_forward)
+        h = pre_acts(params, x)
+        recon_f32, vals, idx = _factored_topk_forward(
+            h, params["W_dec"], cfg.topk_k
+        )
+        recon = (recon_f32 + params["b_dec"].astype(jnp.float32)).astype(x.dtype)
+        f = None
+    elif sparse:
         # factored TopK path: decode touches only the k active rows; the
         # rounding of recon through the compute dtype matches the dense
         # decode's output cast so both paths see the same loss numerics
@@ -344,9 +457,19 @@ def get_losses(
     l2_per_row = jnp.sum(err2, axis=(-2, -1))             # [B]
     l2_loss = jnp.mean(l2_per_row)
 
-    dec_norms = jnp.linalg.norm(params["W_dec"].astype(jnp.float32), axis=-1)  # [H, n]
-    total_dec_norm = jnp.sum(dec_norms, axis=-1)          # [H]
-    if sparse:
+    # L1 is an objective term only when l1_coeff != 0 (TopK-style runs set it
+    # to 0 and control sparsity structurally); off log-steps
+    # (with_metrics=False) a zero-coeff L1 would be pure overhead — the
+    # [H, n] decoder-norm reduce plus a full [B, H] weighted sweep, ~2-3 ms
+    # of the bare TopK step at dict 2^15 — so it is gated exactly like the
+    # other metric-only reductions and returns 0 in that slot.
+    need_l1 = with_metrics or cfg.l1_coeff != 0
+    if need_l1:
+        dec_norms = jnp.linalg.norm(params["W_dec"].astype(jnp.float32), axis=-1)  # [H, n]
+        total_dec_norm = jnp.sum(dec_norms, axis=-1)      # [H]
+    if not need_l1:
+        l1_loss = jnp.zeros((), jnp.float32)
+    elif sparse:
         # identical to the dense weighted L1: inactive latents contribute 0
         w_active = jnp.take(total_dec_norm, idx)          # [B, k]
         l1_loss = jnp.mean(jnp.sum(vals.astype(jnp.float32) * w_active, axis=-1))
@@ -380,7 +503,7 @@ def get_losses(
             )
             fired = hits > 0
         else:
-            fired = jnp.any(ff > 0, axis=0)
+            fired = jnp.any(f > 0, axis=0)
     if dead_mask is not None and cfg.aux_k > 0:
         d_hidden = params["W_dec"].shape[0]
         k_aux = min(cfg.aux_k, d_hidden)
@@ -396,7 +519,12 @@ def get_losses(
         h_all = h if h is not None else pre_acts(params, x)
         neg = jnp.asarray(jnp.finfo(h_all.dtype).min, h_all.dtype)
         ranked = jnp.where(dead_mask[None, :], jax.lax.stop_gradient(h_all), neg)
-        _, aidx = jax.lax.approx_max_k(ranked, k_aux, recall_target=0.95)
+        if cfg.aux_exact_rank:
+            # engine-parity mode: the torch oracle ranks exactly, so the
+            # jax side must select the same latents (cfg.aux_exact_rank)
+            _, aidx = jax.lax.top_k(ranked, k_aux)
+        else:
+            _, aidx = jax.lax.approx_max_k(ranked, k_aux, recall_target=0.95)
         aidx = jax.lax.stop_gradient(aidx)
         avals = jnp.take_along_axis(h_all, aidx, axis=-1)
         avals = jnp.where(jnp.take(dead_mask, aidx), avals, 0)
@@ -450,7 +578,7 @@ def get_losses(
     if sparse:
         l0_loss = jnp.mean(jnp.sum((vals > 0).astype(jnp.float32), axis=-1))
     else:
-        l0_loss = jnp.mean(jnp.sum((ff > 0).astype(jnp.float32), axis=-1))
+        l0_loss = jnp.mean(jnp.sum((f > 0).astype(jnp.float32), axis=-1))
 
     return LossOutput(
         l2_loss=l2_loss,

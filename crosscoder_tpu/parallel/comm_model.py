@@ -1,0 +1,266 @@
+"""Per-step collective-byte accounting from compiled HLO + a scale-out model.
+
+The environment has ONE physical chip, so multi-chip throughput cannot be
+measured — but the quantity that decides whether 8 chips deliver ~8× is
+static: the bytes each step moves over ICI, which XLA fixes at compile
+time. This module compiles the real programs (train step, harvest
+forward, buffer serve) over 1/2/4/8-device meshes (virtual CPU devices —
+the SPMD partitioner emits the same collectives it would for TPU ICI),
+parses every collective op out of the optimized HLO with its shape, and
+combines the byte counts with measured single-chip step times and an ICI
+bandwidth assumption into a predicted per-chip efficiency at width n.
+
+This replaces the reference's absent scaling story (a single-process,
+single-GPU program — reference ``train.py:4``, ``trainer.py:72-82``) with
+the standard JAX/TPU methodology: shard → compile → read the collectives
+out of the HLO → roofline the overlap (jax-ml.github.io/scaling-book).
+
+Key facts the model rests on (asserted by tests/test_comm_model.py):
+
+- Pure DP: the only per-step collective is the gradient+metric psum —
+  byte volume ≈ the parameter pytree (CONSTANT in n, amortized perfectly
+  by batch size), plus O(scalar) metric reductions.
+- DP×TP: weights stay sharded (no weight-sized all-gather — asserted in
+  tests/test_scaleout.py); activation-sized collectives shrink as 1/n
+  with the per-device batch.
+- Harvest under SP: ring attention moves 2 collective-permutes of the
+  per-shard KV block per layer, independent of sequence length beyond
+  the shard size.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# HLO parsing
+# ---------------------------------------------------------------------------
+
+_COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+# e.g. "bf16[4096,2304]{1,0}" or "f32[]" or tuple "(f32[8,2], s32[8])"
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# an HLO op line: "  %name = <shape(s)> op-name(...)" — the op name token
+# right after the shape closes
+_OP_RE = re.compile(
+    r"=\s*(?:\(([^)]*)\)|(\w+\[[\d,]*\](?:\{[^}]*\})?))\s+"
+    r"(" + "|".join(_COLLECTIVES) + r")(?:-start|-done)?\("
+)
+
+
+def _shape_bytes(shape_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_bytes(hlo_text: str) -> dict[str, int]:
+    """Output bytes of every collective in an HLO module, by op kind.
+
+    ``-start``/``-done`` async pairs are counted once (on ``-start``;
+    ``-done`` repeats the shape). Bytes are the op's OUTPUT shape — for
+    all-reduce that equals the input (the reduced tensor), for all-gather
+    the gathered result, for reduce-scatter the scattered shard: in every
+    case the per-device wire traffic is within a small ring-algorithm
+    factor (2(n-1)/n for reduce, (n-1)/n for gather) of this number.
+    """
+    out = {k: 0 for k in _COLLECTIVES}
+    out["count"] = 0
+    for line in hlo_text.splitlines():
+        if "-done(" in line:
+            continue                     # async completion: already counted
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        shape_str = m.group(1) or m.group(2)
+        op = m.group(3)
+        out[op] += _shape_bytes(shape_str)
+        out["count"] += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Program compilation at width n
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CommProfile:
+    """Collective bytes per executed step of one program at mesh width n."""
+
+    program: str
+    n_devices: int
+    model_axis: int
+    bytes_by_op: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(v for k, v in self.bytes_by_op.items() if k != "count")
+
+
+def _compile_train_step(cfg, mesh):
+    """Lower+compile the production train step (no execution)."""
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train.trainer import make_train_step
+    import jax.numpy as jnp
+
+    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    state = jax.eval_shape(
+        lambda k: init_train_state(k, cfg, tx), jax.random.key(0)
+    )
+    shardings = mesh_lib.state_shardings(mesh, state, cfg.shard_sources)
+    step = make_train_step(cfg, mesh, tx, shardings, with_metrics=False)
+    batch = jax.ShapeDtypeStruct(
+        (cfg.batch_size, cfg.n_sources, cfg.d_in), jnp.bfloat16,
+        sharding=mesh_lib.batch_sharding(mesh),
+    )
+    state_sh = jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        state, shardings,
+    )
+    scale = jax.ShapeDtypeStruct(
+        (cfg.n_sources,), jnp.float32,
+        sharding=jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )
+    return step.lower(state_sh, batch, scale).compile()
+
+
+def _compile_harvest(cfg, lm_cfg, mesh, seq_shards: int):
+    """Lower+compile one harvest forward (capture at the hook point)."""
+    from crosscoder_tpu.models import lm
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = jax.eval_shape(lambda k: lm.init_params(k, lm_cfg), jax.random.key(0))
+    rep = NamedSharding(mesh, P())
+    params = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep), params
+    )
+    toks = jax.ShapeDtypeStruct(
+        (cfg.model_batch_size, cfg.seq_len), jnp.int32,
+        # DP harvest shards the batch; SP harvest shards the sequence
+        # internally and takes replicated tokens
+        sharding=NamedSharding(
+            mesh, P() if seq_shards > 1 else P("data", None)
+        ),
+    )
+
+    if seq_shards > 1:
+        def fwd(p, t):
+            return lm.forward_seq_parallel(
+                p, t, lm_cfg, mesh, capture=(cfg.hook_point,),
+                return_logits=False,
+            )
+    else:
+        def fwd(p, t):
+            return lm.forward(p, t, lm_cfg, capture=(cfg.hook_point,),
+                              return_logits=False)
+
+    return jax.jit(fwd).lower(params, toks).compile()
+
+
+def profile_width(n_devices: int, model_axis: int = 1,
+                  dict_size: int = 2**15, d_in: int = 2304,
+                  batch_size: int = 4096, programs=("train", "train_tp",
+                                                    "harvest", "sp_harvest"),
+                  lm_cfg=None, seq_len: int = 1024) -> list[CommProfile]:
+    """Compile the production programs over an n-device mesh and account
+    their collectives. Uses real production shapes — compilation only, no
+    execution, so CPU virtual devices handle full size."""
+    from crosscoder_tpu.config import CrossCoderConfig
+    from crosscoder_tpu.models import lm
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+
+    out: list[CommProfile] = []
+    devices = jax.devices()[:n_devices]
+
+    def prof(name, ma, fn):
+        mesh = mesh_lib.make_mesh(n_devices // ma, ma, devices=devices)
+        compiled = fn(mesh)
+        hlo = compiled.as_text()
+        out.append(CommProfile(name, n_devices, ma, collective_bytes(hlo)))
+
+    base = dict(
+        d_in=d_in, dict_size=dict_size, n_models=2, batch_size=batch_size,
+        enc_dtype="bf16", master_dtype="bf16", log_backend="null",
+    )
+    if "train" in programs:
+        cfg = CrossCoderConfig(**base)
+        prof("train_dp", 1, lambda mesh: _compile_train_step(cfg, mesh))
+    if "train_tp" in programs and model_axis > 1 and n_devices % model_axis == 0:
+        cfg = CrossCoderConfig(
+            **base, data_axis_size=n_devices // model_axis,
+            model_axis_size=model_axis,
+        )
+        prof("train_dp_tp", model_axis,
+             lambda mesh: _compile_train_step(cfg, mesh))
+    if "harvest" in programs or "sp_harvest" in programs:
+        if lm_cfg is None:
+            lm_cfg = lm.LMConfig.gemma2_2b().replace(n_layers=14)
+        hook_layer = min(lm_cfg.n_layers - 1, 14)
+        hcfg = CrossCoderConfig(
+            **base, seq_len=seq_len, model_batch_size=max(4, n_devices),
+            hook_point=f"blocks.{hook_layer}.hook_resid_pre",
+        )
+        if "harvest" in programs:
+            prof("harvest_dp", 1,
+                 lambda mesh: _compile_harvest(hcfg, lm_cfg, mesh, 1))
+        if "sp_harvest" in programs and n_devices > 1:
+            scfg = hcfg.replace(seq_shards=n_devices,
+                                model_batch_size=n_devices)
+            prof("harvest_sp", 1,
+                 lambda mesh: _compile_harvest(scfg, lm_cfg, mesh, n_devices))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The scale-out prediction
+# ---------------------------------------------------------------------------
+
+# v5e public numbers: 197 bf16 TFLOP/s, 819 GB/s HBM, 4 ICI links ×
+# 400 Gbps/link ≈ 200 GB/s aggregate per chip (1D ring uses 2 links ≈
+# 100 GB/s effective per direction pair). Conservative: assume 100 GB/s
+# usable ICI per chip and NO compute/comm overlap (worst case).
+ICI_GBPS = 100.0
+
+
+def predict(step_ms_1chip: float, profile: CommProfile,
+            ici_gbps: float = ICI_GBPS) -> dict:
+    """Predicted per-chip step time at width n: measured single-chip time
+    (per-chip work is constant under DP — the batch scales with n) plus
+    the serialized collective time at the profiled byte volume."""
+    comm_ms = profile.total_bytes / (ici_gbps * 1e9) * 1e3
+    step_n = step_ms_1chip + comm_ms
+    return {
+        "program": profile.program,
+        "n_devices": profile.n_devices,
+        "comm_bytes": profile.total_bytes,
+        "comm_ms_no_overlap": round(comm_ms, 3),
+        "step_ms_predicted": round(step_n, 2),
+        "per_chip_efficiency": round(step_ms_1chip / step_n, 4),
+    }

@@ -1,0 +1,128 @@
+"""Dead-latent resampling (cfg.resample_every): the classic alternative
+to AuxK for reviving dead dictionary latents.
+
+Bricken et al. 2023 ("Towards Monosemanticity", neuron resampling; see
+PAPERS.md) periodically re-initialize dead latents from examples the
+dictionary currently reconstructs worst. No reference counterpart — the
+reference's dense ReLU never faces mass latent death. The TPU rendition
+is one jitted, sharding-aware function (no host-side surgery: parameter
+and optimizer-state edits are `where`-selects over the dict axis, so the
+same program runs under the TP/EP meshes):
+
+1. deadness: ``steps_since_fired >= cfg.resample_threshold_steps``
+   (the same tracker AuxK maintains in ``TrainState.aux``);
+2. sample one batch row per latent with probability ∝ (row L2 residual)²;
+3. dead decoder rows := that row's RESIDUAL direction, normalized per
+   (latent, source) to ``dec_init_norm`` — matching init's row scale
+   (models/crosscoder.py init_params);
+4. dead encoder columns := the same direction scaled to
+   ``0.2 × mean alive encoder norm`` (the Bricken et al. rule: a revived
+   latent should fire, but weakly, so it adapts rather than disrupts);
+5. ``b_enc[dead] := 0``; Adam moments of every edited slice := 0 (stale
+   second-moment estimates would give revived rows a huge first step);
+6. ``steps_since_fired[dead] := 0``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.utils.dtypes import dtype_of
+
+
+def _zero_dead_rows(opt_state: Any, params: dict, dead: jax.Array) -> Any:
+    """Zero the Adam moment slices of the latents being resampled.
+
+    Matching is by the param key on the leaf path + exact shape (the same
+    convention as parallel.mesh.state_shardings), so any optax state that
+    nests the param tree (mu/nu) is covered without reaching into optax
+    internals.
+    """
+    shapes = {k: v.shape for k, v in params.items()}
+    # (the fired tracker lives in state.aux, not opt_state — it is reset
+    # directly in resample(), not here)
+    dict_axis = {"W_enc": 2, "W_dec": 0, "b_enc": 0}
+
+    def fix(path, leaf):
+        for entry in reversed(path):
+            key = getattr(entry, "key", None)
+            if key in dict_axis and getattr(leaf, "shape", None) == shapes.get(key):
+                ax = dict_axis[key]
+                shape = [1] * leaf.ndim
+                shape[ax] = leaf.shape[ax]
+                mask = dead.reshape(shape)
+                return jnp.where(mask, jnp.zeros((), leaf.dtype), leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, opt_state)
+
+
+def make_resample_fn(cfg: CrossCoderConfig, mesh, state_shardings):
+    """Compiled ``(state, batch, scale, key) -> (state, n_resampled)``."""
+
+    def resample(state, batch, scale, key):
+        x = batch.astype(jnp.float32) * scale[None, :, None]
+        params = state.params
+        cp = cc.cast_params(params, dtype_of(cfg.enc_dtype))
+        recon = cc.forward(cp, x.astype(dtype_of(cfg.enc_dtype)), cfg)
+        e = x - recon.astype(jnp.float32)                     # [B, n, d]
+        e2 = jnp.sum(jnp.square(e), axis=(1, 2))              # [B]
+        # sample ∝ loss² (Bricken et al.); logits of the categorical
+        logits = 2.0 * jnp.log(e2 + 1e-30)
+        ridx = jax.random.categorical(
+            key, logits, shape=(cfg.dict_size,)
+        )                                                     # [H]
+        dirs = e[ridx]                                        # [H, n, d]
+        row_norm = jnp.linalg.norm(dirs, axis=-1, keepdims=True)  # [H, n, 1]
+        unit = dirs / (row_norm + 1e-12)
+
+        dead = state.aux["steps_since_fired"] >= cfg.resample_threshold_steps
+        dead_f = dead[:, None, None]
+
+        W_dec = params["W_dec"].astype(jnp.float32)           # [H, n, d]
+        new_dec = jnp.where(dead_f, unit * cfg.dec_init_norm, W_dec)
+
+        W_enc = params["W_enc"].astype(jnp.float32)           # [n, d, H]
+        enc_norm = jnp.sqrt(jnp.sum(jnp.square(W_enc), axis=(0, 1)))  # [H]
+        alive = ~dead
+        n_alive = jnp.maximum(jnp.sum(alive.astype(jnp.float32)), 1.0)
+        mean_alive = jnp.sum(jnp.where(alive, enc_norm, 0.0)) / n_alive
+        # unit over the whole (n, d) extent so the revived encoder column
+        # has exactly the target norm
+        flat_norm = jnp.linalg.norm(
+            dirs.reshape(cfg.dict_size, -1), axis=-1
+        )[:, None, None]
+        enc_dirs = jnp.transpose(dirs / (flat_norm + 1e-12), (1, 2, 0))  # [n, d, H]
+        new_enc = jnp.where(dead[None, None, :], enc_dirs * 0.2 * mean_alive, W_enc)
+
+        new_params = dict(params)
+        new_params["W_dec"] = new_dec.astype(params["W_dec"].dtype)
+        new_params["W_enc"] = new_enc.astype(params["W_enc"].dtype)
+        new_params["b_enc"] = jnp.where(
+            dead, jnp.zeros((), params["b_enc"].dtype), params["b_enc"]
+        )
+        new_opt = _zero_dead_rows(state.opt_state, params, dead)
+        new_aux = dict(state.aux)
+        new_aux["steps_since_fired"] = jnp.where(
+            dead, 0, state.aux["steps_since_fired"]
+        )
+        new_state = state._replace(
+            params=new_params, opt_state=new_opt, aux=new_aux
+        )
+        return new_state, jnp.sum(dead.astype(jnp.int32))
+
+    batch_sh = mesh_lib.batch_sharding(mesh)
+    replicated = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(
+        resample,
+        in_shardings=(state_shardings, batch_sh, replicated, replicated),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )

@@ -49,9 +49,10 @@ def init_train_state(key: jax.Array, cfg: CrossCoderConfig, tx: optax.GradientTr
     dtype = jnp.float32 if cfg.master_dtype == "fp32" else jnp.bfloat16
     params = cc.init_params(key, cfg, dtype=dtype)
     aux = None
-    if cfg.aux_k > 0:
+    if cfg.aux_k > 0 or cfg.resample_every > 0:
         # every latent starts "recently fired": nothing is dead until it
-        # has failed to fire for aux_dead_steps real steps
+        # has failed to fire for aux_dead_steps real steps (AuxK) /
+        # resample_threshold_steps (resampling)
         aux = {"steps_since_fired": jnp.zeros((cfg.dict_size,), jnp.int32)}
     return TrainState(
         params=params, opt_state=tx.init(params),

@@ -43,15 +43,11 @@ class TorchTrainer:
     ) -> None:
         import torch
 
-        if cfg.activation != "relu":
+        if cfg.activation not in ("relu", "topk"):
             raise NotImplementedError(
-                f"torch backend implements the reference's dense-ReLU step only; "
-                f"activation={cfg.activation!r} must use the jax backend"
-            )
-        if cfg.aux_k > 0:
-            raise NotImplementedError(
-                "torch backend has no AuxK dead-latent loss (a TPU-native "
-                "extension); aux_k > 0 must use the jax backend"
+                f"torch backend implements the dense-ReLU step (the "
+                f"reference's) and TopK (+AuxK) for sparse-tier trajectory "
+                f"parity; activation={cfg.activation!r} must use the jax backend"
             )
         self.torch = torch
         self.cfg = cfg
@@ -83,12 +79,28 @@ class TorchTrainer:
         self.sched = torch.optim.lr_scheduler.LambdaLR(
             self.opt, lambda s: lr_lambda(s, cfg)
         )
+        # AuxK tracker, mirroring TrainState.aux (state.py:55)
+        self.steps_since_fired = torch.zeros(
+            cfg.dict_size, dtype=torch.int32, device=device
+        )
 
-    def losses(self, x):
-        """Reference crosscoder.py:96-130 in torch (fp32)."""
+    def losses(self, x, dead_mask=None):
+        """Reference crosscoder.py:96-130 in torch (fp32), plus the
+        TPU build's sparse tier: TopK straight-through (same STE as
+        models.crosscoder.topk) and the AuxK dead-latent loss (same
+        residual-normalized form as crosscoder.get_losses; ranking is
+        EXACT top-k — pair with cfg.aux_exact_rank on the jax side for
+        engine parity runs)."""
         torch = self.torch
+        cfg = self.cfg
         p = self.params
-        f = torch.relu(torch.einsum("bnd,ndh->bh", x, p["W_enc"]) + p["b_enc"])
+        h = torch.einsum("bnd,ndh->bh", x, p["W_enc"]) + p["b_enc"]
+        hp = torch.relu(h)
+        if cfg.activation == "topk":
+            vals, idx = torch.topk(hp, cfg.topk_k, dim=-1)
+            f = torch.zeros_like(hp).scatter(-1, idx, vals)
+        else:
+            f = hp
         recon = torch.einsum("bh,hnd->bnd", f, p["W_dec"]) + p["b_dec"]
         err2 = (recon - x) ** 2
         per_row = err2.sum(dim=(1, 2))
@@ -100,19 +112,62 @@ class TorchTrainer:
         ctr = x - x.mean(0)
         ev = 1 - per_row / ((ctr**2).sum(dim=(1, 2)) + eps)
         ev_src = 1 - err2.sum(-1) / ((ctr**2).sum(-1) + eps)   # [B, n]
-        return {"l2_loss": l2, "l1_loss": l1, "l0_loss": l0,
-                "explained_variance": ev.mean(),
-                "ev_per_source": ev_src.mean(0)}
+        out = {"l2_loss": l2, "l1_loss": l1, "l0_loss": l0,
+               "explained_variance": ev.mean(),
+               "ev_per_source": ev_src.mean(0),
+               "fired": (f > 0).any(dim=0).detach()}
+        if dead_mask is not None and cfg.aux_k > 0:
+            # crosscoder.get_losses AuxK block, torch rendition: rank RAW
+            # pre-acts among dead latents, re-gather for the exact encoder
+            # gradient path, decode densely WITHOUT b_dec, normalize by the
+            # residual's power, gate to 0 when nothing is dead
+            k_aux = min(cfg.aux_k, cfg.dict_size)
+            neg = torch.finfo(h.dtype).min
+            ranked = torch.where(dead_mask[None, :], h.detach(),
+                                 torch.as_tensor(neg, dtype=h.dtype))
+            _, aidx = torch.topk(ranked, k_aux, dim=-1)
+            avals = torch.gather(h, -1, aidx)
+            avals = torch.where(dead_mask[aidx], avals,
+                                torch.zeros((), dtype=h.dtype))
+            e = (x - recon).detach()
+            f_aux = torch.zeros_like(h).scatter(-1, aidx, avals)
+            e_hat = torch.einsum("bh,hnd->bnd", f_aux, p["W_dec"])
+            num = ((e_hat - e) ** 2).sum(dim=(1, 2)).mean()
+            den = (e ** 2).sum(dim=(1, 2)).mean()
+            out["aux_loss"] = torch.where(
+                dead_mask.any(), num / (den + 1e-8),
+                torch.zeros((), dtype=num.dtype),
+            )
+        return out
 
     def step(self) -> dict[str, float]:
         torch = self.torch
+        cfg = self.cfg
         x = torch.as_tensor(
             np.asarray(self.buffer.next(), dtype=np.float32), device=self.device
         )
-        losses = self.losses(x)
+        dead = None
+        aux_on = cfg.aux_k > 0 and (
+            cfg.aux_every <= 1 or self.step_counter % cfg.aux_every == 0
+        )
+        if aux_on:
+            # same warm-in semantics as the jax trainer (trainer.py:96-107)
+            dead = self.steps_since_fired >= cfg.aux_dead_steps
+        losses = self.losses(x, dead_mask=dead)
         l1c = l1_coeff_at(self.step_counter, self.cfg)
         loss = losses["l2_loss"] + l1c * losses["l1_loss"]
+        if aux_on:
+            warm = min(1.0, self.step_counter /
+                       max(cfg.l1_warmup_frac * cfg.total_steps, 1e-9)) \
+                if cfg.l1_warmup_frac > 0 else 1.0
+            loss = loss + cfg.aux_k_coeff * warm * losses["aux_loss"]
         loss.backward()
+        if cfg.aux_k > 0:
+            fired = losses["fired"]
+            self.steps_since_fired = torch.where(
+                fired, torch.zeros((), dtype=torch.int32),
+                self.steps_since_fired + 1,
+            )
         torch.nn.utils.clip_grad_norm_(list(self.params.values()), max_norm=self.cfg.grad_clip)
         # read the lr BEFORE sched.step(): this is λ(step)·lr, the value
         # opt.step() just applied and what the jax trainer logs

@@ -73,9 +73,10 @@ def make_train_step(
     # fired-tracking runs on EVERY aux-enabled step; the aux loss itself
     # only on aux_on steps (``cfg.aux_every`` amortization — the Trainer
     # compiles both variants and alternates)
+    track_fired = cfg.aux_k > 0 or cfg.resample_every > 0
     loss_fn = functools.partial(
         cc.training_loss, cfg=cfg, with_metrics=with_metrics,
-        track_fired=cfg.aux_k > 0,
+        track_fired=track_fired,
     )
     if cfg.remat:
         loss_fn = jax.checkpoint(loss_fn)
@@ -92,7 +93,7 @@ def make_train_step(
             # trainer.py:34-39's ramp, applied to both sparsity terms)
             kwargs["l0_coeff"] = cfg.l0_coeff * warm_fn(state.step)
         dead = None
-        if cfg.aux_k > 0:
+        if track_fired:
             # AuxK (dead-latent revival): latents quiet for aux_dead_steps
             # are "dead"; the aux loss reconstructs the step's residual
             # with the top aux_k of them. Same warmup ramp as the other
@@ -100,9 +101,13 @@ def make_train_step(
             # aux_dead_steps — nothing can be dead yet). ``aux_on=False``
             # (the off-steps of cfg.aux_every amortization) keeps the
             # deadness metric and fired-tracking but compiles the aux
-            # ranking+decode out entirely.
-            dead = state.aux["steps_since_fired"] >= cfg.aux_dead_steps
-            if aux_on:
+            # ranking+decode out entirely. Resampling-only configs
+            # (aux_k == 0, resample_every > 0) track deadness at their
+            # own threshold for the metric + the resample fn.
+            thresh = (cfg.aux_dead_steps if cfg.aux_k > 0
+                      else cfg.resample_threshold_steps)
+            dead = state.aux["steps_since_fired"] >= thresh
+            if cfg.aux_k > 0 and aux_on:
                 kwargs["dead_mask"] = dead
                 kwargs["aux_coeff"] = cfg.aux_k_coeff * warm_fn(state.step)
         (loss, losses), grads = grad_fn(state.params, x, l1_coeff, **kwargs)
@@ -116,14 +121,14 @@ def make_train_step(
             "lr": lr_fn(state.step),
         }
         new_aux = state.aux
-        if cfg.aux_k > 0:
+        if track_fired:
             new_aux = {
                 "steps_since_fired": jnp.where(
                     losses.fired, 0, state.aux["steps_since_fired"] + 1
                 )
             }
             metrics["dead_frac"] = jnp.mean(dead.astype(jnp.float32))
-            if aux_on:
+            if cfg.aux_k > 0 and aux_on:
                 metrics["aux_loss"] = losses.aux_loss
         if with_metrics:
             metrics["l0_loss"] = losses.l0_loss
@@ -376,8 +381,29 @@ class Trainer:
                 with_metrics=full_metrics, aux_on=aux_on,
             )
         batch, scale = self._next_batch()
+        n_resampled = None
+        if (cfg.resample_every > 0 and self._host_step > 0
+                and self._host_step % cfg.resample_every == 0):
+            # dead-latent resampling on the batch about to be trained on
+            # (train/resample.py); runs BEFORE the step so the revived
+            # latents' first gradients come from this same batch
+            if getattr(self, "_resample_fn", None) is None:
+                from crosscoder_tpu.train.resample import make_resample_fn
+
+                self._resample_fn = make_resample_fn(
+                    cfg, self.mesh, self._state_shardings
+                )
+            rkey = jax.random.fold_in(
+                jax.random.key(cfg.seed + 0x5EED), self._host_step
+            )
+            with self._dispatch_lock:
+                self.state, n_resampled = self._resample_fn(
+                    self.state, batch, scale, rkey
+                )
         with self._dispatch_lock:
             self.state, metrics = fn(self.state, batch, scale)
+        if n_resampled is not None:
+            metrics["resampled"] = n_resampled
         self._host_step += 1
         return metrics
 

@@ -30,5 +30,9 @@ def enable(cache_dir: str | None = None) -> str | None:
             ".jax_cache",
         )
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # cache EVERYTHING: the analysis entry points' first call is dominated
+    # by many sub-second compiles (decoder norms, cosines, logit lens —
+    # measured ~16 s of a 25 s dashboard first call through the tunnel)
+    # that a 1.0 s threshold would silently re-pay in every process
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     return cache_dir

@@ -30,6 +30,9 @@ from crosscoder_tpu.checkpoint.ckpt import Checkpointer
 
 
 def main(argv=None):
+    from crosscoder_tpu.utils import compile_cache
+
+    compile_cache.enable()   # warm pods skip the 17s+ first-call compiles
     ap = argparse.ArgumentParser()
     ap.add_argument("--version-dir", required=True)
     ap.add_argument("--save", type=int, default=None)

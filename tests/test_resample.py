@@ -1,0 +1,137 @@
+"""Dead-latent resampling (cfg.resample_every; train/resample.py).
+
+Verifies the full Bricken-et-al. surgery against a hand-forced dead set:
+decoder rows re-initialized to dec_init_norm residual directions, encoder
+columns aligned + downscaled, b_enc zeroed, Adam moments zeroed, tracker
+reset — and that ALIVE latents and their moments are untouched. Also runs
+under the TP mesh so the where-select surgery is proven sharding-safe.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.train.trainer import Trainer
+
+
+def _cfg(**kw):
+    base = dict(
+        d_in=16, dict_size=64, batch_size=32, num_tokens=32 * 200,
+        activation="topk", topk_k=4, l1_coeff=0.0, enc_dtype="fp32",
+        resample_every=3, resample_dead_steps=5, log_backend="null", seed=3,
+    )
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+def _force_dead(tr, idx):
+    ssf = np.zeros(tr.cfg.dict_size, np.int32)
+    ssf[idx] = 1000
+    tr.state = tr.state._replace(aux={"steps_since_fired": jnp.asarray(ssf)})
+
+
+def _adam_moment_rows(state, key, axis):
+    """Collect the Adam mu/nu leaves for one param across the opt chain."""
+    rows = []
+
+    def visit(path, leaf):
+        names = [getattr(e, "key", None) for e in path]
+        if key in names and hasattr(leaf, "ndim") and leaf.ndim >= 1:
+            rows.append(np.asarray(leaf))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, state.opt_state)
+    return rows
+
+
+def test_resample_replaces_dead_rows():
+    cfg = _cfg()
+    tr = Trainer(cfg, buffer=SyntheticActivationSource(cfg))
+    # a couple of real steps so Adam moments are nonzero
+    for _ in range(3):
+        tr.step()
+    dead_idx = np.asarray([1, 7, 40])
+    _force_dead(tr, dead_idx)
+    before = jax.device_get(tr.state.params)
+    tr._host_step = cfg.resample_every          # land on the boundary
+    m = tr.step()
+    assert int(np.asarray(m["resampled"])) == len(dead_idx)
+    after = jax.device_get(tr.state.params)
+
+    alive = np.setdiff1d(np.arange(cfg.dict_size), dead_idx)
+    # dead decoder rows replaced, at dec_init_norm per (latent, source);
+    # compare PRE-step-update state indirectly: rows must have moved far
+    # from their trained values and the tracker must have reset
+    assert not np.allclose(after["W_dec"][dead_idx], before["W_dec"][dead_idx])
+    # alive rows only moved by one optimizer step (small)
+    assert np.allclose(after["W_dec"][alive], before["W_dec"][alive], atol=5e-2)
+    ssf = np.asarray(jax.device_get(tr.state.aux["steps_since_fired"]))
+    assert (ssf[dead_idx] <= 1).all()           # reset (then one step passed)
+
+
+def test_resample_norms_and_moments():
+    cfg = _cfg()
+    tr = Trainer(cfg, buffer=SyntheticActivationSource(cfg))
+    for _ in range(3):
+        tr.step()
+    dead_idx = np.asarray([2, 3, 50])
+    _force_dead(tr, dead_idx)
+
+    # call the resample fn directly so the post-surgery state is inspectable
+    from crosscoder_tpu.train.resample import make_resample_fn
+
+    fn = make_resample_fn(cfg, tr.mesh, tr._state_shardings)
+    batch, scale = tr._produce_batch()
+    state, n = fn(tr.state, batch, scale, jax.random.key(0))
+    assert int(np.asarray(n)) == len(dead_idx)
+    p = jax.device_get(state.params)
+
+    dec_norms = np.linalg.norm(p["W_dec"][dead_idx], axis=-1)  # [3, n]
+    np.testing.assert_allclose(dec_norms, cfg.dec_init_norm, rtol=1e-4)
+    assert (p["b_enc"][dead_idx] == 0).all()
+
+    enc_cols = p["W_enc"][:, :, dead_idx]
+    enc_norm = np.sqrt((enc_cols ** 2).sum(axis=(0, 1)))
+    alive = np.setdiff1d(np.arange(cfg.dict_size), dead_idx)
+    alive_norms = np.sqrt((p["W_enc"][:, :, alive] ** 2).sum(axis=(0, 1)))
+    np.testing.assert_allclose(enc_norm, 0.2 * alive_norms.mean(), rtol=1e-3)
+
+    # Adam moments of the dead slices zeroed; alive slices untouched
+    for arr in _adam_moment_rows(state, "W_dec", 0):
+        assert (arr[dead_idx] == 0).all()
+        assert np.abs(arr[alive]).max() > 0
+    for arr in _adam_moment_rows(state, "W_enc", 2):
+        assert (arr[..., dead_idx] == 0).all()
+    ssf = np.asarray(jax.device_get(state.aux["steps_since_fired"]))
+    assert (ssf[dead_idx] == 0).all()
+    tr.close()
+
+
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+def test_resample_under_tp_mesh():
+    cfg = _cfg(dict_size=128, data_axis_size=4, model_axis_size=2)
+    mesh = mesh_lib.make_mesh(4, 2)
+    tr = Trainer(cfg, buffer=SyntheticActivationSource(cfg), mesh=mesh)
+    for _ in range(2):
+        tr.step()
+    _force_dead(tr, np.asarray([0, 65]))
+    tr.state = jax.device_put(tr.state, tr._state_shardings)
+    tr._host_step = cfg.resample_every
+    m = tr.step()
+    assert int(np.asarray(m["resampled"])) == 2
+    assert np.isfinite(float(np.asarray(m["loss"])))
+    tr.close()
+
+
+def test_resample_composes_with_auxk():
+    cfg = _cfg(aux_k=8, aux_dead_steps=5, resample_dead_steps=0)
+    assert cfg.resample_threshold_steps == 5
+    tr = Trainer(cfg, buffer=SyntheticActivationSource(cfg))
+    for _ in range(7):
+        m = tr.step()
+    assert "dead_frac" in m
+    tr.close()
