@@ -1,0 +1,325 @@
+"""Round-5 activation-quality evidence (VERDICT r4 next #1, #4, #7).
+
+Extends artifacts/ACT_QUALITY_r04.json (kept untouched) with the arms the
+round-4 verdict asked for, same harness: identical fake-LM pair, corpus,
+eval set, and init seeds across arms; train curves + held-out evals.
+
+Arms:
+
+- **Amortization parity** (verdict #1), 10k steps: concentrated AuxK
+  per-step vs cfg.aux_every=8 — dead-fraction trajectory and eval L2 must
+  be within noise for the amortized (1.28x-step-cost) variant to be the
+  production recommendation.
+- **Dead-latent endgame** (verdict #4), 30k steps: plain TopK vs
+  concentrated+amortized AuxK vs Bricken-style RESAMPLING
+  (cfg.resample_every, round-5 feature) vs resampling+AuxK combined.
+  Acceptance: dead fraction < 30% at equal-or-better held-out L2.
+- **JumpReLU θ-schedule study** (verdict #7), 25k steps: (a) θ
+  warm-start — 5k BatchTopK pre-train, calibrate the global threshold,
+  transplant into log_theta, then L0-objective training; (b) stepwise
+  bandwidth annealing 0.1→0.03→0.01 (bandwidth is compile-static, so
+  annealing rebuilds the step at phase boundaries, carrying params +
+  opt state). Target L0 <= 2k within the horizon; otherwise the arms
+  land as the documented negative with θ-velocity stats.
+
+Air-gapped caveat (unchanged from r04): random-weight fake-LM harvest;
+every arm sees the identical activation stream.
+
+Run on TPU:  python _act_quality_r05.py      # AQ5_STEPS=30000 default
+Writes artifacts/ACT_QUALITY_r05.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data.buffer import make_buffer
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.models import lm
+from crosscoder_tpu.train.trainer import Trainer
+from crosscoder_tpu.utils import compile_cache
+
+LONG = int(os.environ.get("AQ5_STEPS", 30_000))
+MID = int(os.environ.get("AQ5_MID_STEPS", 10_000))
+JR = int(os.environ.get("AQ5_JR_STEPS", 25_000))
+LOG_EVERY = int(os.environ.get("AQ5_LOG_EVERY", 200))
+EVAL_EVERY = int(os.environ.get("AQ5_EVAL_EVERY", 1000))
+SEQ_LEN = 129
+HOOK = "blocks.2.hook_resid_pre"
+K = 32
+
+LM_CFG = lm.LMConfig(
+    vocab_size=2048, d_model=128, n_layers=3, n_heads=4, n_kv_heads=2,
+    head_dim=32, d_ff=512, sliding_window=64, query_pre_attn_scalar=32.0,
+    dtype="fp32",
+)
+
+# (steps, overrides, phases) — phases only for the jumprelu study
+ARMS: dict = {
+    # --- amortization parity (10k, vs each other) ---
+    "auxk_strong_perstep": (MID, dict(activation="topk", topk_k=K, l1_coeff=0.0,
+                                      aux_k=2 * K, aux_dead_steps=300,
+                                      aux_k_coeff=0.25)),
+    "auxk_strong_every8": (MID, dict(activation="topk", topk_k=K, l1_coeff=0.0,
+                                     aux_k=2 * K, aux_dead_steps=300,
+                                     aux_k_coeff=0.25, aux_every=8)),
+    # --- dead-latent endgame (30k) ---
+    "topk_30k": (LONG, dict(activation="topk", topk_k=K, l1_coeff=0.0)),
+    "auxk_30k": (LONG, dict(activation="topk", topk_k=K, l1_coeff=0.0,
+                            aux_k=2 * K, aux_dead_steps=300,
+                            aux_k_coeff=0.25, aux_every=8)),
+    "resample_30k": (LONG, dict(activation="topk", topk_k=K, l1_coeff=0.0,
+                                resample_every=1000, resample_dead_steps=300)),
+    "resample_auxk_30k": (LONG, dict(activation="topk", topk_k=K, l1_coeff=0.0,
+                                     aux_k=2 * K, aux_dead_steps=300,
+                                     aux_k_coeff=0.25, aux_every=8,
+                                     resample_every=1000,
+                                     resample_dead_steps=300)),
+}
+
+
+DICT = int(os.environ.get("AQ5_DICT", 8192))     # smoke-shrinkable
+BATCH = int(os.environ.get("AQ5_BATCH", 2048))
+MULT = int(os.environ.get("AQ5_MULT", 64))
+
+
+def arm_cfg(steps: int, **kw) -> CrossCoderConfig:
+    return CrossCoderConfig(
+        d_in=LM_CFG.d_model, dict_size=DICT, n_models=2, batch_size=BATCH,
+        buffer_mult=MULT, seq_len=SEQ_LEN, model_batch_size=16,
+        norm_calib_batches=4, hook_point=HOOK,
+        num_tokens=BATCH * steps, save_every=10**9, log_backend="null",
+        enc_dtype="bf16", buffer_device="hbm", prefetch=True, **kw,
+    )
+
+
+def make_eval(eval_rows, scale, cfg):
+    @jax.jit
+    def eval_stats(params):
+        x = eval_rows.astype(jnp.float32) * scale
+        out = cc.get_losses(params, x, cfg)
+        f = cc.encode(cc.cast_params(params, jnp.bfloat16),
+                      x.astype(jnp.bfloat16), cfg)
+        fired = jnp.any(f > 0, axis=0)
+        return (out.l2_loss, jnp.mean(out.explained_variance),
+                jnp.mean(jnp.sum((f > 0).astype(jnp.float32), axis=-1)),
+                1.0 - jnp.mean(fired.astype(jnp.float32)))
+    return eval_stats
+
+
+def run_phase(tr, cfg, steps, eval_stats, curve, evals, t0, name, step0=0):
+    for s in range(1, steps + 1):
+        step = step0 + s
+        full = step % LOG_EVERY == 0
+        m = tr.step(full_metrics=full)
+        if full:
+            rec = {"step": step, "t": round(time.perf_counter() - t0, 2),
+                   "loss": float(jax.device_get(m["loss"])),
+                   "l2": float(jax.device_get(m["l2_loss"])),
+                   "l0": float(jax.device_get(m["l0_loss"]))}
+            if "dead_frac" in m:
+                rec["train_dead_frac"] = float(jax.device_get(m["dead_frac"]))
+            if "resampled" in m:
+                rec["resampled"] = int(jax.device_get(m["resampled"]))
+            if cfg.activation == "jumprelu":
+                th = jax.device_get(jnp.exp(tr.state.params["log_theta"]))
+                rec["theta_mean"] = float(np.mean(th))
+                rec["theta_p90"] = float(np.quantile(th, 0.9))
+            curve.append(rec)
+        if step % EVAL_EVERY == 0:
+            l2e, eve, l0e, deade = (float(jax.device_get(v))
+                                    for v in eval_stats(tr.state.params))
+            evals.append({"step": step, "t": round(time.perf_counter() - t0, 2),
+                          "eval_l2": l2e, "eval_ev": eve,
+                          "eval_l0": l0e, "eval_dead_frac": deade})
+            print(f"{name} step={step} eval_l2={l2e:.4f} ev={eve:.4f} "
+                  f"L0={l0e:.1f} dead={deade:.4f}", flush=True)
+
+
+def run_simple_arm(name, steps, overrides, pair, corpus, eval_rows) -> dict:
+    cfg = arm_cfg(steps, **overrides)
+    buf = make_buffer(cfg, LM_CFG, pair, corpus)
+    tr = Trainer(cfg, buf)
+    scale = jnp.asarray(buf.normalisation_factor)[None, :, None]
+    eval_stats = make_eval(eval_rows, scale, cfg)
+    curve, evals = [], []
+    t0 = time.perf_counter()
+    run_phase(tr, cfg, steps, eval_stats, curve, evals, t0, name)
+    wall = time.perf_counter() - t0
+    tr.close()
+    return {"cfg": overrides, "steps": steps, "wall_s": round(wall, 1),
+            "train_curve": curve, "eval_curve": evals}
+
+
+def run_jumprelu_warmstart(pair, corpus, eval_rows) -> dict:
+    """5k BatchTopK pre-train -> calibrate global threshold -> transplant
+    into log_theta -> 20k JumpReLU-L0 training (fresh Adam at the switch,
+    recorded)."""
+    pre_steps, jr_steps = JR // 5, JR - JR // 5
+    cfg1 = arm_cfg(pre_steps, activation="batchtopk", topk_k=K, l1_coeff=0.0)
+    buf = make_buffer(cfg1, LM_CFG, pair, corpus)
+    tr1 = Trainer(cfg1, buf)
+    scale = jnp.asarray(buf.normalisation_factor)[None, :, None]
+    eval1 = make_eval(eval_rows, scale, cfg1)
+    curve, evals = [], []
+    t0 = time.perf_counter()
+    run_phase(tr1, cfg1, pre_steps, eval1, curve, evals, t0, "jr_warm.pre")
+    params1 = jax.device_get(tr1.state.params)
+
+    # calibrate the BatchTopK threshold on a few live serve batches
+    batches = [np.asarray(eval_rows[i * BATCH:(i + 1) * BATCH], np.float32)
+               * np.asarray(scale) for i in range(3)]
+    thresh = cc.calibrate_batchtopk_threshold(tr1.state.params, cfg1, batches)
+    tr1.close()
+    print(f"jr_warm: calibrated threshold {thresh:.6f}", flush=True)
+
+    cfg2 = arm_cfg(jr_steps, activation="jumprelu", l1_coeff=0.0,
+                   l0_coeff=1.0, jumprelu_bandwidth=0.03,
+                   jumprelu_theta=max(thresh, 1e-6))
+    buf2 = make_buffer(cfg2, LM_CFG, pair, corpus)
+    tr2 = Trainer(cfg2, buf2)
+    # transplant the pre-trained weights (log_theta comes fresh from
+    # jumprelu_theta = the calibrated threshold); Adam restarts — recorded
+    new_params = dict(tr2.state.params)
+    for k in ("W_enc", "W_dec", "b_enc", "b_dec"):
+        new_params[k] = jnp.asarray(params1[k])
+    tr2.state = jax.device_put(
+        tr2.state._replace(params=new_params), tr2._state_shardings
+    )
+    eval2 = make_eval(eval_rows, jnp.asarray(buf2.normalisation_factor)[None, :, None], cfg2)
+    run_phase(tr2, cfg2, jr_steps, eval2, curve, evals, t0, "jr_warm.jr",
+              step0=pre_steps)
+    wall = time.perf_counter() - t0
+    tr2.close()
+    return {"cfg": {"phase1": "batchtopk 5k", "phase2": "jumprelu l0=1.0 bw=0.03",
+                    "theta_init": float(thresh), "adam_reset_at_switch": True},
+            "steps": JR, "wall_s": round(wall, 1),
+            "train_curve": curve, "eval_curve": evals}
+
+
+def run_jumprelu_anneal(pair, corpus, eval_rows) -> dict:
+    """Stepwise bandwidth annealing 0.1 -> 0.03 -> 0.01 (compile-static
+    bandwidth: each phase rebuilds the trainer, carrying params AND opt
+    state — same param tree, so the transplant is wholesale)."""
+    phases = [(JR // 3, 0.1), (JR // 3, 0.03), (JR - 2 * (JR // 3), 0.01)]
+    curve, evals = [], []
+    t0 = time.perf_counter()
+    carried_state = None
+    step0 = 0
+    wall0 = t0
+    for i, (n, bw) in enumerate(phases):
+        cfg = arm_cfg(JR, activation="jumprelu", l1_coeff=0.0, l0_coeff=1.0,
+                      jumprelu_bandwidth=bw, jumprelu_theta=0.01)
+        buf = make_buffer(cfg, LM_CFG, pair, corpus)
+        tr = Trainer(cfg, buf)
+        if carried_state is not None:
+            tr.state = jax.device_put(carried_state, tr._state_shardings)
+            tr._host_step = step0
+        eval_stats = make_eval(
+            eval_rows, jnp.asarray(buf.normalisation_factor)[None, :, None], cfg)
+        run_phase(tr, cfg, n, eval_stats, curve, evals, t0,
+                  f"jr_anneal.bw{bw}", step0=step0)
+        carried_state = jax.device_get(tr.state)
+        step0 += n
+        tr.close()
+    return {"cfg": {"bandwidth_phases": [list(p) for p in phases],
+                    "l0_coeff": 1.0, "theta_init": 0.01,
+                    "state_carried_across_phases": True},
+            "steps": JR, "wall_s": round(time.perf_counter() - wall0, 1),
+            "train_curve": curve, "eval_curve": evals}
+
+
+def main() -> None:
+    compile_cache.enable()
+    rng = np.random.default_rng(0)
+    corpus = rng.integers(0, LM_CFG.vocab_size, size=(32768, SEQ_LEN), dtype=np.int32)
+    eval_tokens = rng.integers(0, LM_CFG.vocab_size, size=(64, SEQ_LEN), dtype=np.int32)
+    pair = [lm.init_params(jax.random.key(i), LM_CFG) for i in (0, 1)]
+    acts = lm.run_with_cache_multi(pair, jnp.asarray(eval_tokens), LM_CFG, (HOOK,))
+    eval_rows = np.asarray(jax.device_get(acts))[:, 1:].reshape(-1, 2, LM_CFG.d_model)
+    eval_rows = jnp.asarray(eval_rows[:8192], jnp.bfloat16)
+    print(f"eval set: {eval_rows.shape}", flush=True)
+
+    out_path = Path(os.environ.get("AQ5_OUT", "artifacts/ACT_QUALITY_r05.json"))
+    results: dict = {
+        "long_steps": LONG, "mid_steps": MID, "jr_steps": JR, "k": K,
+        "workload": f"dict 8192, batch 2048, d_in {LM_CFG.d_model}, "
+                    "3-layer random-weight pair, hbm buffer",
+        "caveat": "random-weight fake-LM harvest (air-gapped); every arm "
+                  "sees the identical activation stream",
+        "runs": {},
+    }
+    if out_path.exists():
+        prev = json.loads(out_path.read_text())
+        if (prev.get("long_steps"), prev.get("mid_steps"), prev.get("jr_steps")) \
+                == (LONG, MID, JR):
+            results["runs"] = prev.get("runs", {})
+            print(f"resuming artifact: have {sorted(results['runs'])}", flush=True)
+
+    def save():
+        out_path.parent.mkdir(exist_ok=True)
+        out_path.write_text(json.dumps(results, indent=1))
+
+    for name, (steps, overrides) in ARMS.items():
+        if name in results["runs"]:
+            continue
+        results["runs"][name] = run_simple_arm(
+            name, steps, overrides, pair, corpus, eval_rows)
+        save()
+    if "jumprelu_warmstart" not in results["runs"]:
+        results["runs"]["jumprelu_warmstart"] = run_jumprelu_warmstart(
+            pair, corpus, eval_rows)
+        save()
+    if "jumprelu_bw_anneal" not in results["runs"]:
+        results["runs"]["jumprelu_bw_anneal"] = run_jumprelu_anneal(
+            pair, corpus, eval_rows)
+        save()
+
+    # ---- summary ----
+    runs = results["runs"]
+
+    def final(name):
+        return runs[name]["eval_curve"][-1] if name in runs else None
+
+    def dead_curve(name):
+        return [(e["step"], round(e["eval_dead_frac"], 4))
+                for e in runs[name]["eval_curve"]] if name in runs else None
+
+    ps, e8 = final("auxk_strong_perstep"), final("auxk_strong_every8")
+    summary: dict = {
+        "amortization_parity": {
+            "perstep": ps, "every8": e8,
+            "eval_l2_rel": round((e8["eval_l2"] - ps["eval_l2"]) / ps["eval_l2"], 4)
+            if ps and e8 else None,
+            "dead_frac_delta": round(e8["eval_dead_frac"] - ps["eval_dead_frac"], 4)
+            if ps and e8 else None,
+        },
+        "endgame_30k": {
+            n: {"final": final(n), "dead_curve": dead_curve(n)}
+            for n in ("topk_30k", "auxk_30k", "resample_30k", "resample_auxk_30k")
+            if n in runs
+        },
+        "jumprelu_study": {
+            n: {"final": final(n),
+                "l0_curve": [(e["step"], round(e["eval_l0"], 1))
+                             for e in runs[n]["eval_curve"]]}
+            for n in ("jumprelu_warmstart", "jumprelu_bw_anneal") if n in runs
+        },
+        "wall_s": {n: r["wall_s"] for n, r in runs.items()},
+    }
+    results["summary"] = summary
+    save()
+    print(json.dumps(summary, indent=1, default=str), flush=True)
+    print(f"wrote {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
