@@ -71,6 +71,12 @@ ARMS: dict = {
     "auxk_strong_every8": (MID, dict(activation="topk", topk_k=K, l1_coeff=0.0,
                                      aux_k=2 * K, aux_dead_steps=300,
                                      aux_k_coeff=0.25, aux_every=8)),
+    # coefficient compensated x8 so the INTEGRATED aux gradient matches the
+    # per-step arm (first every8 result: L2 parity but dead-reduction lost)
+    "auxk_strong_every8_c8": (MID, dict(activation="topk", topk_k=K,
+                                        l1_coeff=0.0, aux_k=2 * K,
+                                        aux_dead_steps=300,
+                                        aux_k_coeff=2.0, aux_every=8)),
     # --- dead-latent endgame (30k) ---
     "topk_30k": (LONG, dict(activation="topk", topk_k=K, l1_coeff=0.0)),
     "auxk_30k": (LONG, dict(activation="topk", topk_k=K, l1_coeff=0.0,
@@ -83,6 +89,13 @@ ARMS: dict = {
                                      aux_k_coeff=0.25, aux_every=8,
                                      resample_every=1000,
                                      resample_dead_steps=300)),
+    # the 0.2x encoder downscale loses the TopK selection race (measured:
+    # resample_30k cycles resample->die->resample, eval dead unchanged);
+    # full-scale revived encoders can actually compete for the top-k
+    "resample_scale1_30k": (LONG, dict(activation="topk", topk_k=K,
+                                       l1_coeff=0.0, resample_every=1000,
+                                       resample_dead_steps=300,
+                                       resample_enc_scale=1.0)),
 }
 
 
@@ -120,6 +133,12 @@ def run_phase(tr, cfg, steps, eval_stats, curve, evals, t0, name, step0=0):
         step = step0 + s
         full = step % LOG_EVERY == 0
         m = tr.step(full_metrics=full)
+        if not full and "resampled" in m:
+            # resample events land on off-log steps (every resample_every+1);
+            # record them anyway so the event cadence is in the artifact
+            curve.append({"step": step,
+                          "resampled": int(jax.device_get(m["resampled"])),
+                          "train_dead_frac": float(jax.device_get(m["dead_frac"]))})
         if full:
             rec = {"step": step, "t": round(time.perf_counter() - t0, 2),
                    "loss": float(jax.device_get(m["loss"])),
@@ -294,17 +313,23 @@ def main() -> None:
                 for e in runs[name]["eval_curve"]] if name in runs else None
 
     ps, e8 = final("auxk_strong_perstep"), final("auxk_strong_every8")
+    c8 = final("auxk_strong_every8_c8")
     summary: dict = {
         "amortization_parity": {
-            "perstep": ps, "every8": e8,
+            "perstep": ps, "every8": e8, "every8_c8": c8,
             "eval_l2_rel": round((e8["eval_l2"] - ps["eval_l2"]) / ps["eval_l2"], 4)
             if ps and e8 else None,
             "dead_frac_delta": round(e8["eval_dead_frac"] - ps["eval_dead_frac"], 4)
             if ps and e8 else None,
+            "c8_eval_l2_rel": round((c8["eval_l2"] - ps["eval_l2"]) / ps["eval_l2"], 4)
+            if ps and c8 else None,
+            "c8_dead_frac_delta": round(c8["eval_dead_frac"] - ps["eval_dead_frac"], 4)
+            if ps and c8 else None,
         },
         "endgame_30k": {
             n: {"final": final(n), "dead_curve": dead_curve(n)}
-            for n in ("topk_30k", "auxk_30k", "resample_30k", "resample_auxk_30k")
+            for n in ("topk_30k", "auxk_30k", "resample_30k", "resample_auxk_30k",
+                      "resample_scale1_30k")
             if n in runs
         },
         "jumprelu_study": {

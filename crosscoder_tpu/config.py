@@ -148,6 +148,17 @@ class CrossCoderConfig:
                                     # aux_k (either or both).
     resample_dead_steps: int = 0    # deadness threshold for resampling;
                                     # 0 = inherit aux_dead_steps
+    resample_enc_scale: float = 0.2  # revived encoder norm as a fraction
+                                    # of the mean ALIVE encoder norm.
+                                    # 0.2 is the Bricken et al. SAE rule
+                                    # (fire weakly, adapt gently) — but
+                                    # under TopK a downscaled encoder can
+                                    # never WIN the top-k selection race,
+                                    # so revived latents cycle
+                                    # resample→die→resample (measured:
+                                    # ACT_QUALITY_r05 resample_30k, dead
+                                    # 86% unchanged); 1.0 gives revived
+                                    # latents full competitive scale
     batchtopk_threshold: float = 0.0   # >0: batchtopk EVAL mode — a fixed
                                     # global threshold (from
                                     # crosscoder.calibrate_batchtopk_threshold)

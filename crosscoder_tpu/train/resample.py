@@ -16,8 +16,10 @@ same program runs under the TP/EP meshes):
    (latent, source) to ``dec_init_norm`` — matching init's row scale
    (models/crosscoder.py init_params);
 4. dead encoder columns := the same direction scaled to
-   ``0.2 × mean alive encoder norm`` (the Bricken et al. rule: a revived
-   latent should fire, but weakly, so it adapts rather than disrupts);
+   ``cfg.resample_enc_scale × mean alive encoder norm`` (0.2 is the
+   Bricken et al. rule — fire weakly, adapt gently — but see the config
+   note: under TopK the downscale loses the selection race; 1.0 restores
+   competitiveness);
 5. ``b_enc[dead] := 0``; Adam moments of every edited slice := 0 (stale
    second-moment estimates would give revived rows a huge first step);
 6. ``steps_since_fired[dead] := 0``.
@@ -100,7 +102,10 @@ def make_resample_fn(cfg: CrossCoderConfig, mesh, state_shardings):
             dirs.reshape(cfg.dict_size, -1), axis=-1
         )[:, None, None]
         enc_dirs = jnp.transpose(dirs / (flat_norm + 1e-12), (1, 2, 0))  # [n, d, H]
-        new_enc = jnp.where(dead[None, None, :], enc_dirs * 0.2 * mean_alive, W_enc)
+        new_enc = jnp.where(
+            dead[None, None, :],
+            enc_dirs * cfg.resample_enc_scale * mean_alive, W_enc,
+        )
 
         new_params = dict(params)
         new_params["W_dec"] = new_dec.astype(params["W_dec"].dtype)

@@ -1,0 +1,88 @@
+"""Render artifacts/ACT_QUALITY_r05.json as a two-panel figure.
+
+Left: held-out dead-latent fraction over 30k steps for the endgame arms
+(plain TopK / amortized AuxK / resampling / both) plus the 10k
+amortization-parity arms. Right: JumpReLU effective L0 trajectories
+(log scale) for the θ-schedule arms against the k and 2k targets.
+
+Usage: python scripts/render_quality_r05.py [in.json] [out.png]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+
+def curve(run, key):
+    return ([e["step"] for e in run["eval_curve"]],
+            [e[key] for e in run["eval_curve"]])
+
+
+def main() -> None:
+    src = sys.argv[1] if len(sys.argv) > 1 else "artifacts/ACT_QUALITY_r05.json"
+    out = sys.argv[2] if len(sys.argv) > 2 else "artifacts/ACT_QUALITY_r05.png"
+    d = json.load(open(src))
+    runs = d["runs"]
+    k = d["k"]
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(12.5, 4.6))
+
+    left = [
+        ("topk_30k", "plain TopK", "#888888", "-"),
+        ("auxk_30k", "AuxK (amortized, conc.)", "#d62728", "-"),
+        ("resample_30k", "resampling", "#1f77b4", "-"),
+        ("resample_auxk_30k", "resampling + AuxK", "#2ca02c", "-"),
+        ("resample_scale1_30k", "resampling, full-scale enc", "#17becf", "-"),
+        ("auxk_strong_perstep", "AuxK per-step (10k)", "#d62728", ":"),
+        ("auxk_strong_every8", "AuxK every-8 (10k)", "#ff7f0e", ":"),
+        ("auxk_strong_every8_c8", "every-8, coeff ×8 (10k)", "#9467bd", ":"),
+    ]
+    for name, label, color, ls in left:
+        if name not in runs:
+            continue
+        s, v = curve(runs[name], "eval_dead_frac")
+        ax1.plot(s, [100 * x for x in v], ls, color=color, label=label, lw=1.8)
+    ax1.axhline(30, color="k", lw=0.8, ls="--", alpha=0.5)
+    ax1.text(200, 31, "30% target", fontsize=8, alpha=0.7)
+    ax1.set_xlabel("step")
+    ax1.set_ylabel("held-out dead-latent fraction (%)")
+    ax1.set_title(f"Dead latents: revival mechanisms (dict 8192, k={k})")
+    ax1.legend(fontsize=8, loc="center right")
+    ax1.set_ylim(0, 100)
+
+    right = [
+        ("jumprelu_warmstart", "θ warm-start (BatchTopK 5k → L0)", "#1f77b4"),
+        ("jumprelu_bw_anneal", "bandwidth anneal 0.1→0.03→0.01", "#d62728"),
+    ]
+    for name, label, color in right:
+        if name not in runs:
+            continue
+        s, v = curve(runs[name], "eval_l0")
+        ax2.plot(s, v, color=color, label=label, lw=1.8)
+    ax2.axhline(k, color="k", lw=0.8, ls="--", alpha=0.6)
+    ax2.axhline(2 * k, color="k", lw=0.8, ls=":", alpha=0.6)
+    ax2.text(200, k * 1.1, f"k={k}", fontsize=8, alpha=0.7)
+    ax2.text(200, 2 * k * 1.1, "2k target", fontsize=8, alpha=0.7)
+    ax2.set_yscale("log")
+    ax2.set_xlabel("step")
+    ax2.set_ylabel("held-out effective L0 (log)")
+    ax2.set_title("JumpReLU θ-schedule study")
+    ax2.legend(fontsize=8)
+
+    for ax in (ax1, ax2):
+        ax.spines[["top", "right"]].set_visible(False)
+        ax.grid(alpha=0.25, lw=0.5)
+    fig.suptitle(d.get("workload", ""), fontsize=9, y=1.0)
+    fig.tight_layout()
+    fig.savefig(out, dpi=140)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
