@@ -135,10 +135,11 @@ def run_phase(tr, cfg, steps, eval_stats, curve, evals, t0, name, step0=0):
         m = tr.step(full_metrics=full)
         if not full and "resampled" in m:
             # resample events land on off-log steps (every resample_every+1);
-            # record them anyway so the event cadence is in the artifact
+            # record the event count (how many were dead at the surgery).
+            # NOT dead_frac: the surgery already reset the tracker for the
+            # resampled latents, so that metric is 0 by construction here.
             curve.append({"step": step,
-                          "resampled": int(jax.device_get(m["resampled"])),
-                          "train_dead_frac": float(jax.device_get(m["dead_frac"]))})
+                          "resampled": int(jax.device_get(m["resampled"]))})
         if full:
             rec = {"step": step, "t": round(time.perf_counter() - t0, 2),
                    "loss": float(jax.device_get(m["loss"])),

@@ -340,6 +340,15 @@ def section_configs() -> list[dict]:
 def section_e2e() -> dict:
     """harvest→buffer→train on one chip — the number the reference pipeline
     actually bounds (harvest ≈ 2.5× the train step's FLOPs per row)."""
+    # Harvest-quantum granularity for THIS box: each sub-scan dispatch
+    # costs ~6-8 ms of host time through the single-core axon tunnel, so
+    # fine segmentation (the library default SEG_LAYERS=3, right for
+    # production hosts with ~100 us dispatch) costs ~10% e2e throughput
+    # here. 14 = one segment per model: ~25.0k acts/s with the refresh
+    # bubble at 24-32% of a median step across runs (vs ~22.5k / 2.5% at
+    # 3) — the measured frontier is in ROUND5_NOTES §2; override to
+    # re-measure. Resolved at use time by SegmentedHarvest.seg_layers().
+    os.environ.setdefault("CROSSCODER_SEG_LAYERS", "14")
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -42,6 +42,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
 from dataclasses import dataclass
 from typing import Any, Callable, Mapping, Sequence
 
@@ -687,7 +688,22 @@ class SegmentedHarvest:
     ``step()`` budget, for pacing.
     """
 
-    SEG_LAYERS = 3
+    # Harvest quantum granularity: layers per sub-scan. Trade (measured,
+    # BENCH e2e, gemma-2-2b pair, 14 scanned layers): smaller segments
+    # bound the refresh bubble tighter (a quantum lands inside whichever
+    # train step queues behind it) but each segment dispatch costs host
+    # time (~6-8 ms through a tunneled single-core client; ~100 us on a
+    # production host) — sweep results in artifacts/ROUND5_NOTES.md §2.
+    # None = resolve $CROSSCODER_SEG_LAYERS at USE time (default 3), so
+    # the env knob works regardless of import order; setting the class
+    # attribute to an int overrides both.
+    SEG_LAYERS: int | None = None
+
+    @classmethod
+    def seg_layers(cls) -> int:
+        if cls.SEG_LAYERS is not None:
+            return cls.SEG_LAYERS
+        return int(os.environ.get("CROSSCODER_SEG_LAYERS", "3"))
 
     def __init__(
         self,
@@ -715,7 +731,7 @@ class SegmentedHarvest:
     def count(cls, cfg: LMConfig, hook_points: Sequence[str], n_models: int) -> int:
         """``step()`` calls a job over these hooks will need (for pacing)."""
         n_scan = min(cfg.n_layers, _scan_stop(_hook_layers(cfg, tuple(hook_points))))
-        return n_models * max(1, -(-n_scan // cls.SEG_LAYERS))
+        return n_models * max(1, -(-n_scan // cls.seg_layers()))
 
     def step(self) -> bool:
         """Dispatch the next quantum; False once fully dispatched."""
@@ -727,7 +743,7 @@ class SegmentedHarvest:
                 len(self.capture),
             )
         if self._lo < self.n_scan:
-            k = min(self.SEG_LAYERS, self.n_scan - self._lo)
+            k = min(self.seg_layers(), self.n_scan - self._lo)
             self._resid, self._buf = _seg_scan_impl(
                 self.params_seq[self._model_idx], self._resid, self._buf,
                 jnp.int32(self._lo), self.cfg, self.capture, k,
