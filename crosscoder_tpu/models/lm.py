@@ -719,6 +719,10 @@ class SegmentedHarvest:
         self.capture = _hook_layers(cfg, tuple(hook_points))
         self.n_scan = min(cfg.n_layers, _scan_stop(self.capture))
         self.out_dtype = out_dtype
+        # snapshot the granularity for the job's whole life: n_steps (the
+        # pacing denominator) and the per-step slice width must agree even
+        # if the knob changes while this job is in flight
+        self._seg_layers = self.seg_layers()
         self.n_steps = self.count(cfg, hook_points, len(self.params_seq))
         self._model_idx = 0
         self._lo = 0
@@ -743,7 +747,7 @@ class SegmentedHarvest:
                 len(self.capture),
             )
         if self._lo < self.n_scan:
-            k = min(self.seg_layers(), self.n_scan - self._lo)
+            k = min(self._seg_layers, self.n_scan - self._lo)
             self._resid, self._buf = _seg_scan_impl(
                 self.params_seq[self._model_idx], self._resid, self._buf,
                 jnp.int32(self._lo), self.cfg, self.capture, k,

@@ -1,0 +1,85 @@
+"""JumpReLU θ warm-start: convert a trained TopK/BatchTopK crosscoder
+into a JumpReLU init whose threshold starts AT the k-sparse regime.
+
+Why this exists (measured, artifacts/ACT_QUALITY_r05.json): training
+JumpReLU with the L0 objective from the paper-default θ=0.001 cannot
+reach L0 ≈ k — the rectangle-STE θ gradient is too slow to travel two
+orders of magnitude of threshold (L0 equilibrates at ~4-5k even with
+bandwidth annealing). Warm-starting log_theta from the BatchTopK
+threshold CALIBRATED on the trained weights holds L0 ≤ 2k through 25k
+steps with the best held-out L2 of any arm in the study. The recipe:
+
+    cfg1 = cfg.replace(activation="batchtopk", topk_k=K, l1_coeff=0.0)
+    ...train for ~5k steps...
+    cfg2 = cfg.replace(activation="jumprelu", l0_coeff=1.0,
+                       jumprelu_bandwidth=0.03)
+    params2 = jumprelu_warmstart_params(tr.state.params, cfg1, cfg2,
+                                        calibration_batches)
+    tr2 = Trainer(cfg2, ...); tr2.state = tr2.state._replace(
+        params=jax.device_put(params2, ...))
+
+No reference counterpart (the reference is dense-ReLU only).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.models import crosscoder as cc
+
+
+def jumprelu_warmstart_params(
+    params: cc.Params,
+    cfg_from: CrossCoderConfig,
+    cfg_to: CrossCoderConfig,
+    batches,
+) -> cc.Params:
+    """Trained TopK/BatchTopK params → JumpReLU params with calibrated θ.
+
+    ``batches``: a few representative ``[B, n_sources, d_in]`` activation
+    batches (normalized exactly as training batches were) — the threshold
+    is the mean per-batch BatchTopK threshold at ``cfg_from.topk_k``
+    (:func:`crosscoder_tpu.models.crosscoder.calibrate_batchtopk_threshold`).
+
+    The encoder/decoder/bias leaves carry over unchanged; ``log_theta``
+    is created at ``log(threshold)`` for every latent. The caller is
+    responsible for a fresh optimizer state (θ has no moments yet, and
+    the carried weights' stale moments would mis-scale their first
+    updates under a new objective).
+
+    Donation caveat: once these params are handed to a Trainer, treat
+    them as CONSUMED — the trainer's donated step deletes the underlying
+    buffers (``jax.device_put`` onto an identical sharding can alias
+    rather than copy), so reading the returned dict after the first
+    ``step()`` raises "Array has been deleted".
+    """
+    if cfg_to.activation != "jumprelu":
+        raise ValueError(
+            f"cfg_to.activation must be 'jumprelu', got {cfg_to.activation!r}"
+        )
+    if cfg_from.activation not in ("topk", "batchtopk"):
+        raise ValueError(
+            "warm-start calibrates a TopK-order-statistic threshold; "
+            f"cfg_from.activation must be topk|batchtopk, got "
+            f"{cfg_from.activation!r}"
+        )
+    n, d_in, h = params["W_enc"].shape
+    if (h, d_in, n) != (cfg_to.dict_size, cfg_to.d_in, cfg_to.n_sources):
+        raise ValueError(
+            f"trained params are dict_size={h}, d_in={d_in}, n_sources={n} "
+            f"but cfg_to expects {cfg_to.dict_size}/{cfg_to.d_in}/"
+            f"{cfg_to.n_sources} — the transplant carries the weights, so "
+            "the target config must match their shapes"
+        )
+    thresh = cc.calibrate_batchtopk_threshold(params, cfg_from, batches)
+    if thresh <= 0:
+        raise ValueError(
+            f"calibrated threshold {thresh} <= 0 (all pre-acts non-positive "
+            "on the calibration batches?) — cannot initialize log_theta"
+        )
+    out = {k: v for k, v in params.items() if k != "log_theta"}
+    out["log_theta"] = jnp.full(
+        (cfg_to.dict_size,), jnp.log(thresh), dtype=jnp.float32
+    )
+    return out

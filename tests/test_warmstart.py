@@ -1,0 +1,76 @@
+"""JumpReLU θ warm-start (train/warmstart.py): the transplant must carry
+the trained leaves, set log_theta to the calibrated threshold, produce an
+immediate effective L0 near k, and train under the JumpReLU objective."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+from crosscoder_tpu.models import crosscoder as cc
+from crosscoder_tpu.train.trainer import Trainer
+from crosscoder_tpu.train.warmstart import jumprelu_warmstart_params
+
+K = 8
+
+
+def _cfg(**kw):
+    base = dict(d_in=16, dict_size=256, batch_size=64, num_tokens=64 * 200,
+                enc_dtype="fp32", log_backend="null", seed=5)
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+def test_warmstart_transplant_and_l0():
+    cfg1 = _cfg(activation="batchtopk", topk_k=K, l1_coeff=0.0)
+    tr = Trainer(cfg1, buffer=SyntheticActivationSource(cfg1))
+    for _ in range(30):
+        tr.step()
+    src = SyntheticActivationSource(cfg1)
+    batches = [src.next() for _ in range(3)]
+    cfg2 = _cfg(activation="jumprelu", l1_coeff=0.0, l0_coeff=1.0,
+                jumprelu_bandwidth=0.03)
+    p1 = jax.device_get(tr.state.params)
+    p2 = jumprelu_warmstart_params(tr.state.params, cfg1, cfg2, batches)
+    tr.close()
+
+    # carried leaves identical; log_theta at a single calibrated value
+    for k in ("W_enc", "W_dec", "b_enc", "b_dec"):
+        np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p2[k]))
+    theta = np.exp(np.asarray(p2["log_theta"]))
+    assert theta.shape == (cfg2.dict_size,)
+    assert np.allclose(theta, theta[0]) and theta[0] > 0
+
+    # immediate effective L0 is in the k regime, not the dense regime
+    x = jnp.asarray(batches[0])
+    f = cc.encode(p2, x.astype(jnp.float32), cfg2)
+    l0 = float(jnp.mean(jnp.sum((f > 0).astype(jnp.float32), axis=-1)))
+    assert K / 4 <= l0 <= 4 * K, l0
+
+    # and the jumprelu trainer runs from the transplant
+    tr2 = Trainer(cfg2, buffer=SyntheticActivationSource(cfg2))
+    tr2.state = tr2.state._replace(
+        params=jax.device_put(
+            {k: jnp.asarray(v) for k, v in p2.items()},
+            jax.tree_util.tree_map(lambda s: s, tr2._state_shardings.params),
+        )
+    )
+    losses = [float(np.asarray(jax.device_get(tr2.step()["loss"])))
+              for _ in range(10)]
+    assert all(np.isfinite(losses))
+    tr2.close()
+
+
+def test_warmstart_validation():
+    cfg1 = _cfg(activation="batchtopk", topk_k=K, l1_coeff=0.0)
+    params = cc.init_params(jax.random.key(0), cfg1)
+    src = SyntheticActivationSource(cfg1)
+    batches = [src.next()]
+    with pytest.raises(ValueError, match="jumprelu"):
+        jumprelu_warmstart_params(params, cfg1, cfg1, batches)
+    cfg_relu = _cfg(activation="relu")
+    cfg2 = _cfg(activation="jumprelu", l1_coeff=0.0)
+    with pytest.raises(ValueError, match="topk|batchtopk"):
+        jumprelu_warmstart_params(params, cfg_relu, cfg2, batches)
