@@ -79,10 +79,47 @@ def test_gradient_parity_at_exact_zero_survivors():
     assert int((np.asarray(g_dense) != 0).sum()) == 1  # only the 3.0 entry
 
 
+def test_composite_wide_width_oracle():
+    """The slim composite leg at width_bits=16 (bf16 2^16 — the width the
+    round-5 kernel exists for) and at a %128-but-not-%4096 width, against
+    the dense oracle in interpreter mode; plus the NaN int32-overflow
+    guard (a 0x7FFF-payload NaN at key position (bits<<16 | col) would
+    wrap ``hi = max+1`` without the clamp)."""
+    import numpy as np
+
+    from crosscoder_tpu.ops import topk_pallas as tp
+
+    for width in (2**16, 36992):
+        h = jax.random.normal(jax.random.key(0), (8, width), jnp.bfloat16)
+        assert tp._composite_supported(h, 8)
+        out = tp.topk(h, 8, True)
+        ref = act._topk_dense(h, 8)
+        assert bool(jnp.all(out == ref)), width
+
+    # NaN with the MAXIMAL payload (bf16 pattern 0x7FFF) in column 0 — the
+    # exact key that would overflow hi = max+1 without the clamp: clean
+    # rows must stay bit-exact; the NaN row must still keep >= k-1 of the
+    # true finite top-k (ordering among NaN payloads is outside the
+    # oracle contract)
+    h = jax.random.normal(jax.random.key(1), (8, 2**16), jnp.bfloat16)
+    worst_nan = jax.lax.bitcast_convert_type(
+        jnp.uint16(0x7FFF), jnp.bfloat16
+    )
+    assert bool(jnp.isnan(worst_nan))
+    h = h.at[0, 0].set(worst_nan)
+    out = np.asarray(tp.topk(h, 8, True)).astype(np.float32)
+    ref = np.asarray(act._topk_dense(h, 8)).astype(np.float32)
+    for r in range(1, 8):
+        assert np.array_equal(out[r], ref[r]), r
+    kept = np.count_nonzero(out[0] != 0) + np.isnan(out[0]).sum()
+    assert kept >= 7, kept
+
+
 def test_supported_covers_wide_dicts():
-    """Widths whose rows exceed one VMEM block route to the width-chunked
-    variant (round-3; VERDICT round-2 weak #1) instead of falling back to
-    dense: supported() is True at every BASELINE dict size."""
+    """supported() is True at every BASELINE dict size: bf16 2^15/2^16 via
+    the slim composite single-block, bf16 2^17 and f32 2^16+ via the
+    width-chunked variant (round-3; VERDICT round-2 weak #1) instead of
+    falling back to dense."""
     import jax
 
     from crosscoder_tpu.ops import topk_pallas as tp
