@@ -1,0 +1,61 @@
+"""50k-step robustness checks for the two round-5 headline quality
+findings (reuses the r05 rig's harness/arms verbatim, longer horizon):
+
+- jumprelu_warmstart: does L0 keep drifting past 2k after 25k steps, or
+  equilibrate? (25k ended at 58.1, decelerating.)
+- auxk_30k config at 50k: is 1.3% dead an equilibrium or a transient?
+
+Writes artifacts/ACT_QUALITY_r05_50k.json.
+"""
+import os
+os.environ.setdefault("AQ5_OUT", "artifacts/ACT_QUALITY_r05_50k.json")
+import json
+import numpy as np
+import jax, jax.numpy as jnp
+import _act_quality_r05 as rig
+from crosscoder_tpu.models import lm
+from crosscoder_tpu.utils import compile_cache
+
+STEPS = 50_000
+rig.JR = STEPS          # warm-start arm: 10k pre + 40k jumprelu
+
+
+def main():
+    compile_cache.enable()
+    rng = np.random.default_rng(0)
+    corpus = rng.integers(0, rig.LM_CFG.vocab_size, size=(32768, rig.SEQ_LEN), dtype=np.int32)
+    eval_tokens = rng.integers(0, rig.LM_CFG.vocab_size, size=(64, rig.SEQ_LEN), dtype=np.int32)
+    pair = [lm.init_params(jax.random.key(i), rig.LM_CFG) for i in (0, 1)]
+    acts = lm.run_with_cache_multi(pair, jnp.asarray(eval_tokens), rig.LM_CFG, (rig.HOOK,))
+    eval_rows = np.asarray(jax.device_get(acts))[:, 1:].reshape(-1, 2, rig.LM_CFG.d_model)
+    eval_rows = jnp.asarray(eval_rows[:8192], jnp.bfloat16)
+
+    out_path = os.environ["AQ5_OUT"]
+    results = {"steps": STEPS, "runs": {},
+               "workload": "same harness as ACT_QUALITY_r05, 50k horizon"}
+    if os.path.exists(out_path):
+        prev = json.load(open(out_path))
+        if prev.get("steps") == STEPS:
+            results["runs"] = prev["runs"]
+
+    if "auxk_50k" not in results["runs"]:
+        results["runs"]["auxk_50k"] = rig.run_simple_arm(
+            "auxk_50k", STEPS,
+            dict(activation="topk", topk_k=rig.K, l1_coeff=0.0,
+                 aux_k=2 * rig.K, aux_dead_steps=300,
+                 aux_k_coeff=0.25, aux_every=8),
+            pair, corpus, eval_rows)
+        json.dump(results, open(out_path, "w"), indent=1)
+    if "jumprelu_warmstart_50k" not in results["runs"]:
+        results["runs"]["jumprelu_warmstart_50k"] = rig.run_jumprelu_warmstart(
+            pair, corpus, eval_rows)
+        json.dump(results, open(out_path, "w"), indent=1)
+
+    for n, r in results["runs"].items():
+        e = r["eval_curve"][-1]
+        print(n, "final:", {k: round(v, 3) for k, v in e.items() if k != "t"})
+    print("wrote", out_path)
+
+
+if __name__ == "__main__":
+    main()
