@@ -30,12 +30,19 @@ def main() -> None:
     d = json.load(open(src))
     runs = d["runs"]
     k = d["k"]
+    # fold in the 50k robustness arms when their artifact exists
+    try:
+        extra = json.load(open("artifacts/ACT_QUALITY_r05_50k.json"))
+        runs.update(extra.get("runs", {}))
+    except FileNotFoundError:
+        pass
 
     fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(12.5, 4.6))
 
     left = [
         ("topk_30k", "plain TopK", "#888888", "-"),
         ("auxk_30k", "AuxK (amortized, conc.)", "#d62728", "-"),
+        ("auxk_50k", "AuxK, 50k horizon", "#d62728", "--"),
         ("resample_30k", "resampling", "#1f77b4", "-"),
         ("resample_auxk_30k", "resampling + AuxK", "#2ca02c", "-"),
         ("resample_scale1_30k", "resampling, full-scale enc", "#17becf", "-"),
@@ -57,14 +64,15 @@ def main() -> None:
     ax1.set_ylim(0, 100)
 
     right = [
-        ("jumprelu_warmstart", "θ warm-start (BatchTopK 5k → L0)", "#1f77b4"),
-        ("jumprelu_bw_anneal", "bandwidth anneal 0.1→0.03→0.01", "#d62728"),
+        ("jumprelu_warmstart", "θ warm-start (BatchTopK 5k → L0)", "#1f77b4", "-"),
+        ("jumprelu_warmstart_50k", "θ warm-start, 50k", "#1f77b4", "--"),
+        ("jumprelu_bw_anneal", "bandwidth anneal 0.1→0.03→0.01", "#d62728", "-"),
     ]
-    for name, label, color in right:
+    for name, label, color, ls in right:
         if name not in runs:
             continue
         s, v = curve(runs[name], "eval_l0")
-        ax2.plot(s, v, color=color, label=label, lw=1.8)
+        ax2.plot(s, v, ls, color=color, label=label, lw=1.8)
     ax2.axhline(k, color="k", lw=0.8, ls="--", alpha=0.6)
     ax2.axhline(2 * k, color="k", lw=0.8, ls=":", alpha=0.6)
     ax2.text(200, k * 1.1, f"k={k}", fontsize=8, alpha=0.7)
