@@ -25,6 +25,7 @@ wrong slots. Old positional (``leaf_i``) saves still load.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -35,6 +36,14 @@ import jax
 import numpy as np
 
 from crosscoder_tpu.config import CrossCoderConfig
+
+
+def _sha256_file(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def _fsync_dir(path: Path) -> None:
@@ -50,26 +59,34 @@ def _fsync_dir(path: Path) -> None:
         os.close(fd)
 
 
-def _atomic_savez(path: Path, arrays: dict[str, np.ndarray]) -> None:
+def _atomic_savez(path: Path, arrays: dict[str, np.ndarray]) -> str:
     """npz write that becomes visible all-or-nothing: stream into a
     ``.tmp`` sibling, fsync, ``os.replace`` (atomic on POSIX), fsync the
     directory. A process killed mid-write leaves only the tmp file, which
     every reader path (``latest_save``/``restore``) ignores; the fsyncs
     extend the guarantee to power loss, and cost nothing on the critical
-    path now that writes ride the background thread."""
+    path now that writes ride the background thread.
+
+    Returns the artifact's SHA-256 (hashed from the tmp file before the
+    rename — np.savez's zip writer seeks back to patch headers, so a
+    write-through tee hash would record stale header bytes). The meta
+    marker records these digests; verified restore checks them."""
     tmp = path.with_name(path.name + ".tmp")
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
         f.flush()
         os.fsync(f.fileno())
+    digest = _sha256_file(tmp)
     os.replace(tmp, path)
     _fsync_dir(path.parent)
+    return digest
 
 
-def _atomic_write_text(path: Path, text: str) -> None:
+def _atomic_write_text(path: Path, text: str) -> str:
     """Atomic sibling of :func:`_atomic_savez` for the JSON artifacts — the
     meta file is the save's completion marker, so it especially must never
-    exist half-written (or durable ahead of the files it marks)."""
+    exist half-written (or durable ahead of the files it marks). Returns
+    the text's SHA-256."""
     tmp = path.with_name(path.name + ".tmp")
     with open(tmp, "w") as f:
         f.write(text)
@@ -77,19 +94,38 @@ def _atomic_write_text(path: Path, text: str) -> None:
         os.fsync(f.fileno())
     os.replace(tmp, path)
     _fsync_dir(path.parent)
+    return hashlib.sha256(text.encode()).hexdigest()
 
 
 class Checkpointer:
-    def __init__(self, base_dir: str | Path | None = None, cfg: CrossCoderConfig | None = None) -> None:
+    def __init__(
+        self,
+        base_dir: str | Path | None = None,
+        cfg: CrossCoderConfig | None = None,
+        chaos: Any | None = None,
+        counters: Any | None = None,
+    ) -> None:
         if base_dir is None:
             base_dir = cfg.checkpoint_dir if cfg is not None else "./checkpoints"
         self.base_dir = Path(base_dir)
         self.save_dir: Path | None = None
         self.save_version = 0
+        # fault-injection hook (resilience/chaos.py): corrupts a just-
+        # written save's artifacts when the chaos plan says so; None (the
+        # default and every production path) is never called
+        self.chaos = chaos
+        # resilience/* metric channel (utils.logging.ResilienceCounters);
+        # restore bumps corrupt_artifact_skips when a save fails checksum
+        # verification. The Trainer shares its own instance in here.
+        self.counters = counters
         # background-write state (save(background=True)): one writer thread
         # at a time; wait() joins it and re-raises any write failure
         self._writer: threading.Thread | None = None
         self._writer_error: BaseException | None = None
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        if self.counters is not None:
+            self.counters.bump(name, n)
 
     def wait(self, raise_error: bool = True) -> None:
         """Block until any in-flight background write has finished; raises
@@ -118,7 +154,7 @@ class Checkpointer:
 
     @staticmethod
     def _fetch_global(leaf: Any) -> np.ndarray:
-        """Leaf → host numpy, safe on a multi-host mesh.
+        """Leaf → host numpy the caller OWNS, safe on a multi-host mesh.
 
         ``np.asarray`` on a sharded ``jax.Array`` whose shards live on
         other processes' devices raises (the leaf is not fully
@@ -126,12 +162,26 @@ class Checkpointer:
         ``process_allgather`` — a COLLECTIVE, so every process must reach
         this call (``Trainer.save`` runs save on all processes and gates
         only the file writes). Single-process arrays take the cheap path.
+
+        The ownership copy is load-bearing for background saves: on the
+        CPU backend ``np.asarray(jax.Array)`` can be a ZERO-COPY view of
+        the device buffer, and the train step DONATES its state — XLA
+        reuses that memory for later steps, so a background writer
+        serializing the view records a LATER step's bytes under this
+        save's meta (observed live: ``train_state`` at step 10 under
+        ``meta["step"] == 5``, with a NaN step in between — a silently
+        poisoned checkpoint that the divergence guard's finite-params
+        fallback caught). Device→host copies (TPU) already own their
+        data, so the guard costs nothing there.
         """
         if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
             from jax.experimental import multihost_utils
 
             return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
-        return np.asarray(leaf)
+        out = np.asarray(leaf)
+        if isinstance(out, np.ndarray) and not out.flags.owndata:
+            out = out.copy()
+        return out
 
     @classmethod
     def _flatten(cls, tree: Any) -> dict[str, np.ndarray]:
@@ -209,14 +259,28 @@ class Checkpointer:
             save_dir = self.save_dir
 
             def write() -> None:
-                _atomic_savez(save_dir / f"{v}.npz", weights)
-                _atomic_write_text(save_dir / f"{v}_cfg.json", cfg.to_json_str())
-                _atomic_savez(save_dir / f"{v}_train_state.npz", flat_state)
+                # per-artifact SHA-256, recorded in the meta marker so
+                # restore can prove the bytes it reads are the bytes that
+                # were written (bit-rot / partial-page corruption slips
+                # past the presence-only torn-save check)
+                sums = {
+                    f"{v}.npz": _atomic_savez(save_dir / f"{v}.npz", weights),
+                    f"{v}_cfg.json": _atomic_write_text(
+                        save_dir / f"{v}_cfg.json", cfg.to_json_str()
+                    ),
+                    f"{v}_train_state.npz": _atomic_savez(
+                        save_dir / f"{v}_train_state.npz", flat_state
+                    ),
+                }
+                meta["checksums"] = sums
                 # meta LAST: its presence marks the save complete —
                 # latest_save keys off it, so a torn save is unreadable
                 _atomic_write_text(
                     save_dir / f"{v}_meta.json", json.dumps(meta, indent=2)
                 )
+                self._prune_saves(save_dir, cfg.keep_saves)
+                if self.chaos is not None:
+                    self.chaos.corrupt_save(save_dir, v)
                 print(f"Saved as version {v} in {save_dir}")
 
             if background:
@@ -236,6 +300,38 @@ class Checkpointer:
         if self.save_dir is None:
             return None
         return self.save_dir / f"{v}.npz"
+
+    @classmethod
+    def _prune_saves(cls, save_dir: Path, keep: int) -> None:
+        """Keep-last-k retention: delete all but the newest ``keep``
+        COMPLETE saves of this version dir (``keep <= 0`` = unbounded,
+        the pre-retention behavior). Runs on the writer, after the new
+        save's meta lands — the newly-written save always survives. The
+        meta marker is unlinked FIRST so a crash mid-prune leaves a torn
+        (invisible) save, never a meta vouching for deleted artifacts."""
+        if keep <= 0:
+            return
+        for old in cls.complete_saves(save_dir)[:-keep]:
+            for name in (f"{old}_meta.json", f"{old}.npz",
+                         f"{old}_train_state.npz", f"{old}_cfg.json"):
+                (save_dir / name).unlink(missing_ok=True)
+
+    def discard_saves_after(self, version_dir: str | Path, v: int) -> None:
+        """Branch truncation for rollback: delete every complete save
+        NEWER than ``v`` in this version dir. After a divergence rollback
+        the run continues from ``v`` on a new trajectory; the stale newer
+        saves (possibly carrying the poisoned state the rollback escaped)
+        must not be what a later auto-resume picks. Meta is unlinked first
+        (same torn-not-corrupt ordering as retention pruning); only the
+        writing process touches the filesystem."""
+        if jax.process_index() != 0:
+            return
+        vdir = Path(version_dir)
+        for s in self.complete_saves(vdir):
+            if s > v:
+                for name in (f"{s}_meta.json", f"{s}.npz",
+                             f"{s}_train_state.npz", f"{s}_cfg.json"):
+                    (vdir / name).unlink(missing_ok=True)
 
     # --- load/restore -------------------------------------------------------
     @staticmethod
@@ -283,6 +379,63 @@ class Checkpointer:
         )
 
     @classmethod
+    def verify_save(cls, version_dir: str | Path, v: int) -> bool:
+        """Integrity check of one complete save: every artifact the meta
+        marker vouches for exists and matches its recorded SHA-256. Saves
+        from before the checksum era (no ``checksums`` key) are trusted,
+        as are hand-assembled weights-only dirs (no meta at all is handled
+        by the caller — this method is only meaningful for meta-marked
+        saves). An unreadable/undecodable meta counts as corrupt."""
+        vdir = Path(version_dir)
+        try:
+            meta = json.loads((vdir / f"{v}_meta.json").read_text())
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            return False
+        sums = meta.get("checksums")
+        if not sums:
+            return True     # pre-checksum save: presence is all we have
+        for name, want in sums.items():
+            path = vdir / name
+            if not path.exists() or _sha256_file(path) != want:
+                return False
+        return True
+
+    def _select_verified(self, version_dir: str | Path | None) -> tuple[Path, int]:
+        """Newest save that passes :meth:`verify_save`, searching the given
+        version dir (or, when None, every version dir newest-first). Saves
+        failing verification are skipped — counted in
+        ``resilience/corrupt_artifact_skips`` — and the search falls back
+        to the previous complete save, then to earlier version dirs; the
+        keep-last-k retention policy (``cfg.keep_saves``) is what keeps
+        this fallback chain non-empty without unbounded disk."""
+        if version_dir is not None:
+            dirs = [Path(version_dir)]
+            if not self.complete_saves(dirs[0]):
+                raise FileNotFoundError(
+                    f"no complete (meta-marked) save under {dirs[0]}; "
+                    "saves torn mid-write are not resumable"
+                )
+        else:
+            dirs = [d for d in reversed(self._version_dirs(self.base_dir))
+                    if self.complete_saves(d)]
+            if not dirs:
+                raise FileNotFoundError(
+                    f"no version dir under {self.base_dir} holds a complete "
+                    "(meta-marked) save"
+                )
+        for vdir in dirs:
+            for v in reversed(self.complete_saves(vdir)):
+                if self.verify_save(vdir, v):
+                    return vdir, v
+                self._bump("corrupt_artifact_skips")
+                print(f"[crosscoder_tpu] checkpoint save {v} in {vdir} "
+                      f"failed checksum verification; falling back to the "
+                      f"previous intact save", flush=True)
+        raise FileNotFoundError(
+            f"no complete save under {dirs} passed checksum verification"
+        )
+
+    @classmethod
     def latest_save(cls, version_dir: str | Path) -> int:
         # key off the meta file — it is written LAST (atomically), so its
         # presence proves the whole save landed; globbing *.npz would pick
@@ -319,31 +472,83 @@ class Checkpointer:
         v = cls.latest_save(vdir) if save is None else save
         cfg = CrossCoderConfig.from_json(vdir / f"{v}_cfg.json")
         with np.load(vdir / f"{v}.npz") as z:
-            params = {k: jax.numpy.asarray(z[k]) for k in z.files}
+            # the added zero forces XLA-owned buffers (see restore(): a
+            # zero-copy alias of the npz's numpy memory must not leak
+            # into device state that downstream code may donate)
+            params = {
+                k: (lambda a: a + jax.numpy.zeros((), a.dtype))(
+                    jax.numpy.asarray(z[k])
+                )
+                for k in z.files
+            }
         return params, cfg
 
     def restore(
         self, cfg: CrossCoderConfig, tx: Any, version_dir: str | Path | None = None, save: int | None = None
     ) -> tuple[Any, dict]:
-        """Rebuild the full TrainState (+ pipeline meta) for resume."""
+        """Rebuild the full TrainState (+ pipeline meta) for resume.
+
+        Auto-selection (``save=None``) only ever touches COMPLETE saves —
+        a save (or whole fresh-run dir) torn by a mid-write kill is
+        skipped — and additionally VERIFIES each candidate's per-artifact
+        checksums, falling back past corrupted saves (and whole version
+        dirs) to the newest intact one. On a multi-process mesh the
+        chosen save is agreed across hosts (allgather-min, so a host
+        whose local filesystem view is ahead rolls back with the rest);
+        an explicitly requested ``save`` is the caller's agreement and is
+        verified but not negotiated — corruption there raises."""
         from crosscoder_tpu.train.state import init_train_state
 
         self.wait()  # a background write from THIS instance must land first
 
-        # auto-resume only ever touches COMPLETE saves: the newest version
-        # dir with one, and within it the newest meta-marked save — a save
-        # (or whole fresh-run dir) torn by a mid-write kill is skipped
-        vdir = Path(version_dir) if version_dir else self._latest_resumable_dir(self.base_dir)
         if save is None:
-            complete = self.complete_saves(vdir)
-            if not complete:
-                raise FileNotFoundError(
-                    f"no complete (meta-marked) save under {vdir}; "
-                    "saves torn mid-write are not resumable"
-                )
-            v = complete[-1]
+            vdir, v = self._select_verified(version_dir)
+            if jax.process_count() > 1:
+                # all processes must rebuild the SAME state: agree on the
+                # minimum (version dir, save id) — ties to the most
+                # conservative host, so a shared-FS lag or host-local
+                # corruption pulls every process back together instead of
+                # leaving hosts resuming from different steps. The dir is
+                # negotiated FIRST (bare save ids are only comparable
+                # within one dir); an explicitly passed version_dir is
+                # already the callers' agreement and only the save id is
+                # negotiated. The agreed save is re-verified locally — a
+                # host that cannot produce those bytes must fail loudly,
+                # not load unverified artifacts.
+                from jax.experimental import multihost_utils
+
+                def _agree_min(x: int) -> int:
+                    return int(multihost_utils.process_allgather(
+                        np.array([x], np.int32)
+                    ).min())
+
+                if version_dir is None:
+                    vnum = int(vdir.name.split("_")[1])
+                    agreed_dir = _agree_min(vnum)
+                    if agreed_dir != vnum:
+                        vdir = self.base_dir / f"version_{agreed_dir}"
+                        # newest locally-verified save of the agreed dir
+                        vdir, v = self._select_verified(vdir)
+                agreed = _agree_min(v)
+                if agreed != v:
+                    print(f"[crosscoder_tpu] multihost restore agreement: "
+                          f"local save {v} -> agreed save {agreed}", flush=True)
+                    v = agreed
+                    if not self.verify_save(vdir, v):
+                        raise ValueError(
+                            f"multihost-agreed save {v} under {vdir} is "
+                            "missing or fails checksum verification on this "
+                            "host; refusing to load unverified state"
+                        )
         else:
+            vdir = Path(version_dir) if version_dir else self._latest_resumable_dir(self.base_dir)
             v = save
+            if not self.verify_save(vdir, v):
+                self._bump("corrupt_artifact_skips")
+                raise ValueError(
+                    f"checkpoint save {v} under {vdir} failed checksum "
+                    "verification (corrupt or truncated artifact)"
+                )
         template = init_train_state(jax.random.key(cfg.seed), cfg, tx)
         pathed, treedef = jax.tree_util.tree_flatten_with_path(template)
         with np.load(vdir / f"{v}_train_state.npz") as z:
@@ -370,7 +575,16 @@ class Checkpointer:
                 if (raw.dtype.kind == "V" and raw.dtype != want
                         and raw.dtype.itemsize == want.itemsize):
                     raw = raw.view(want)
-                loaded.append(jax.numpy.asarray(raw, dtype=leaf.dtype))
+                arr = jax.numpy.asarray(raw, dtype=leaf.dtype)
+                # force an XLA-OWNED buffer: on the CPU backend
+                # jnp.asarray can ZERO-COPY the numpy buffer, and a state
+                # whose leaves alias numpy memory is later DONATED by the
+                # train step — observed as flaky segfaults / NaN'd state
+                # when training resumes after a mid-run restore (the
+                # compile cache perturbs allocator timing enough to
+                # surface it). The added zero runs an actual program, so
+                # the result lives in memory XLA allocated and may free.
+                loaded.append(arr + jax.numpy.zeros((), arr.dtype))
         for (path, b), a in zip(pathed, loaded):
             if a.shape != b.shape:
                 raise ValueError(

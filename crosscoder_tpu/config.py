@@ -226,6 +226,39 @@ class CrossCoderConfig:
                                     # would defeat async dispatch; 20
                                     # bounds the stop latency at ~20 steps
                                     # while costing <5% of steps a sync.
+    # --- resilience (crosscoder_tpu/resilience; docs/resilience.md) ---
+    guard_loss: bool = False        # divergence guard: at log_every
+                                    # granularity (piggybacking the log
+                                    # step's existing loss fetch — the
+                                    # fast path gains NO host sync),
+                                    # non-finite or spiking loss triggers
+                                    # rollback to the last intact save +
+                                    # skip of the poisoned data window
+    loss_spike_factor: float = 10.0  # loss > factor × last healthy logged
+                                    # loss counts as divergence
+    max_rollbacks: int = 3          # rollbacks per train() before the
+                                    # guard aborts loudly (a fault that
+                                    # reproduces past the skipped window
+                                    # is a bug, not a transient)
+    keep_saves: int = 0             # >0: keep only the last k COMPLETE
+                                    # saves per version dir (the retention
+                                    # policy verified restore's fallback
+                                    # assumes); 0 = unbounded (reference-
+                                    # compatible). k >= 2 recommended so a
+                                    # corrupt newest save has an intact
+                                    # predecessor.
+    harvest_timeout_s: float = 0.0  # >0: watchdog on the serve/harvest
+                                    # path — escalating-patience stall
+                                    # detection + exponential-backoff
+                                    # retry of exceptions (resilience/
+                                    # watchdog.py). 0 = off (default).
+    harvest_retries: int = 3        # watchdog retry/extension budget
+    harvest_backoff_s: float = 0.5  # base of the exponential retry backoff
+    chaos: str = ""                 # fault-injection spec (resilience/
+                                    # chaos.py grammar; tests/staging
+                                    # only). Empty = no chaos objects
+                                    # constructed anywhere.
+
     # master-weight/Adam-moment dtype. fp32 (default) is a quality upgrade
     # over the reference; "bf16" reproduces the reference exactly (its params
     # AND torch-Adam moments are bf16, train.py:5 + crosscoder.py:30-34) and
@@ -336,6 +369,28 @@ class CrossCoderConfig:
         if self.stop_poll_every < 1:
             raise ValueError(
                 f"stop_poll_every must be >= 1, got {self.stop_poll_every}"
+            )
+        if self.loss_spike_factor <= 1.0:
+            raise ValueError(
+                f"loss_spike_factor must be > 1 (it multiplies the last "
+                f"healthy loss), got {self.loss_spike_factor}"
+            )
+        if self.max_rollbacks < 0:
+            raise ValueError(f"max_rollbacks must be >= 0, got {self.max_rollbacks}")
+        if self.keep_saves < 0:
+            raise ValueError(f"keep_saves must be >= 0 (0 = unbounded), got {self.keep_saves}")
+        if self.guard_loss and self.keep_saves == 1:
+            raise ValueError(
+                "guard_loss with keep_saves=1 leaves rollback no fallback "
+                "save when the newest is corrupt/poisoned; use keep_saves=0 "
+                "(unbounded) or >= 2"
+            )
+        if self.harvest_timeout_s < 0:
+            raise ValueError(f"harvest_timeout_s must be >= 0, got {self.harvest_timeout_s}")
+        if self.harvest_retries < 0 or self.harvest_backoff_s < 0:
+            raise ValueError(
+                f"harvest_retries/harvest_backoff_s must be >= 0, got "
+                f"{self.harvest_retries}/{self.harvest_backoff_s}"
             )
 
     # --- derived quantities -------------------------------------------------

@@ -113,6 +113,7 @@ class PairedActivationBuffer:
         tokens: np.ndarray | jax.Array,
         batch_sharding: Any | None = None,
         lazy: bool = False,
+        chaos: Any | None = None,
     ) -> None:
         if len(model_params) != cfg.n_models:
             raise ValueError(f"got {len(model_params)} param sets for n_models={cfg.n_models}")
@@ -127,6 +128,10 @@ class PairedActivationBuffer:
         self.cfg = cfg
         self.lm_cfg = lm_cfg
         self.model_params = list(model_params)
+        # fault-injection hook (resilience/chaos.py): fires at each harvest
+        # chunk's dispatch; None (default, all production configs) is never
+        # consulted beyond an is-None check
+        self.chaos = chaos
         self.tokens = np.asarray(tokens)
         if self.tokens.ndim != 2 or self.tokens.shape[1] != cfg.seq_len:
             raise ValueError(f"tokens must be [n_seqs, {cfg.seq_len}], got {self.tokens.shape}")
@@ -399,6 +404,8 @@ class PairedActivationBuffer:
     def _harvest_job(self, padded_tokens: np.ndarray):
         """A segment-steppable harvest job for one fixed-shape chunk (the
         incremental-refill counterpart of :meth:`_harvest_dev`)."""
+        if self.chaos is not None:
+            self.chaos.on_harvest()    # injected stall/failure (tests only)
         if self._seq_mesh is not None:
             return _SingleDispatchJob(self._harvest_dev(padded_tokens))
         tok = jnp.asarray(padded_tokens)

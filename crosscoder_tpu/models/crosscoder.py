@@ -617,6 +617,32 @@ def training_loss(
     Params may be fp32 masters; they are cast to ``cfg.enc_dtype`` here so
     the einsums hit the MXU in bf16 while gradients accumulate into fp32.
     """
+    # The l1 metric/objective term is compiled out when with_metrics=False
+    # AND cfg.l1_coeff == 0 (get_losses's need_l1 gate — a static decision).
+    # The objective here multiplies the DYNAMIC ``l1_coeff`` argument, so a
+    # direct caller passing a nonzero runtime coefficient against
+    # cfg.l1_coeff == 0 would silently train l2 + coeff·0. Catch every
+    # concretely-checkable disagreement; a traced coefficient can't be
+    # inspected, but the production trainer derives it from cfg.l1_coeff's
+    # schedule, so trace-time values always agree with the static gate.
+    if not with_metrics and cfg.l1_coeff == 0:
+        concrete: float | None = None
+        if not isinstance(l1_coeff, jax.core.Tracer):
+            # python numbers, numpy scalars (np.float32 is NOT a float
+            # subclass), and concrete jax scalars all float(); anything
+            # that can't is treated as unknowable, like a tracer
+            try:
+                concrete = float(l1_coeff)
+            except (TypeError, ValueError):
+                concrete = None
+        if concrete is not None and concrete != 0.0:
+            raise ValueError(
+                f"training_loss got l1_coeff={concrete} but cfg.l1_coeff == 0 "
+                "and with_metrics=False: the L1 term is compiled out on this "
+                "path, so the sparsity penalty would be silently dropped. "
+                "Set cfg.l1_coeff to the intended scale (the schedule-derived "
+                "argument then agrees) or pass with_metrics=True."
+            )
     losses = get_losses(
         cast_params(params, dtype_of(cfg.enc_dtype)), x, cfg, with_metrics,
         dead_mask=dead_mask, track_fired=track_fired,

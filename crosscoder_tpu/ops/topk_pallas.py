@@ -188,7 +188,23 @@ def _topk_mask_kernel_composite(h_ref, out_ref, *, k: int, width_bits: int):
     # neighbor NaN encoding — ordering AMONG NaN payloads is outside the
     # oracle contract anyway (lax.top_k's NaN ranking is unspecified);
     # all finite values (max pattern 0x7F80 = +inf) are unaffected.
-    bits = jnp.minimum(bits, jnp.int32(0x7FFE))
+    #
+    # SIGN-SET patterns need their own branch BEFORE that clamp: jnp.maximum
+    # may propagate a negative-payload NaN (or, on a loose backend, -0.0)
+    # with the sign bit intact, so ``bits`` can reach [0x8000, 0xFFFF] —
+    # where a bare min(bits, 0x7FFE) silently ranks the pattern as the
+    # NaN sentinel, making -0.0 "NaN" and hiding that a negative NaN only
+    # propagates by accident of the clamp. Instead: negative NaNs
+    # (> 0xFF80 = -inf's pattern) map to the same 0x7FFE NaN sentinel the
+    # positive clamp uses, and every other sign-set pattern (-0.0, or any
+    # negative value a nonconforming max let through) maps to 0 — exactly
+    # what max(x, 0) should have produced for it.
+    neg = bits >= 0x8000
+    bits = jnp.where(
+        neg,
+        jnp.where(bits > 0xFF80, jnp.int32(0x7FFE), jnp.int32(0)),
+        jnp.minimum(bits, jnp.int32(0x7FFE)),
+    )
     rows, width = h_ref.shape
     col = jax.lax.broadcasted_iota(jnp.int32, (rows, width), 1)
     comp = jax.lax.shift_left(bits, width_bits) | (width - 1 - col)
@@ -623,9 +639,23 @@ def set_interpret(flag: bool) -> None:
     _INTERPRET = flag
 
 
+def _sparsify_rows(cw: int, n_rows: int, itemsize: int) -> int:
+    """Row-block height for the sparsify drain: the default 256, shrunk
+    (multiple-of-32) for small inputs AND for wide single chunks whose
+    VMEM working set — the f32 ``rem`` scratch plus the input block at its
+    own dtype, ~(4 + itemsize) B/element — would blow the module's 13 MB
+    budget at full height (e.g. width 8064 f32 at 256 rows is 16.5 MB;
+    192 rows fit). Same shrink-to-fit rule as ``_composite_rows``."""
+    rows = min(_SPARSIFY_ROWS, -(-n_rows // 32) * 32)
+    cap = _VMEM_BUDGET_BYTES // (cw * (4 + itemsize)) // 32 * 32
+    return max(32, min(rows, cap))
+
+
 def sparsify_supported(width: int, k: int) -> bool:
     """Shapes the sparsify drain kernel handles: chunk-divisible width (or
-    a single narrow chunk) and a sane k."""
+    a single chunk — whose VMEM geometry ``_sparsify_rows`` bounds: every
+    width <= 8192 fits the budget at >= 32 rows even in f32) and a sane
+    k."""
     return 0 < k <= 128 and (width % _SPARSIFY_CW == 0 or width <= 8192)
 
 
@@ -694,7 +724,7 @@ def sparsify(f: jax.Array, k: int, interpret: bool = False
     n_rows = flat.shape[0]
     cw = _SPARSIFY_CW if width % _SPARSIFY_CW == 0 else width
     n_chunks = width // cw
-    rows = min(_SPARSIFY_ROWS, -(-n_rows // 32) * 32)
+    rows = _sparsify_rows(cw, n_rows, jnp.dtype(f.dtype).itemsize)
     pad = (-n_rows) % rows
     if pad:
         flat = jnp.pad(flat, ((0, pad), (0, 0)))

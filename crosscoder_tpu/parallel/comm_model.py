@@ -59,7 +59,7 @@ _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 # right after the shape closes
 _OP_RE = re.compile(
     r"=\s*(?:\(([^)]*)\)|(\w+\[[\d,]*\](?:\{[^}]*\})?))\s+"
-    r"(" + "|".join(_COLLECTIVES) + r")(?:-start|-done)?\("
+    r"(" + "|".join(_COLLECTIVES) + r")(-start|-done)?\("
 )
 
 
@@ -85,6 +85,14 @@ def collective_bytes(hlo_text: str) -> dict[str, int]:
     the gathered result, for reduce-scatter the scattered shard: in every
     case the per-device wire traffic is within a small ring-algorithm
     factor (2(n-1)/n for reduce, (n-1)/n for gather) of this number.
+
+    ``collective-permute-start`` and ``all-gather-start`` tuples carry the
+    operand alias ALONGSIDE the result, ``(operand, result, scratch...)``
+    — counting every element would tally them ~2x (permute-heavy programs
+    like the ring-attention harvest were overcounted exactly that way);
+    only the result element (index 1) is counted for those. Other
+    ``-start`` tuples (e.g. a variadic combined ``all-reduce-start``) hold
+    ONLY results, so every element counts.
     """
     out = {k: 0 for k in _COLLECTIVES}
     out["count"] = 0
@@ -95,7 +103,18 @@ def collective_bytes(hlo_text: str) -> dict[str, int]:
         if not m:
             continue
         shape_str = m.group(1) or m.group(2)
-        op = m.group(3)
+        op, suffix = m.group(3), m.group(4)
+        if (suffix == "-start" and m.group(1) is not None
+                and op in ("collective-permute", "all-gather")):
+            # async tuple (operand, result[, u32 contexts]): the RESULT is
+            # element 1; context scratch has no counted dtype anyway
+            typed = [s for s in _SHAPE_RE.findall(m.group(1))
+                     if s[0] in _DTYPE_BYTES]
+            if len(typed) >= 2:
+                dtype, dims = typed[1]
+                out[op] += _shape_bytes(f"{dtype}[{dims}]")
+                out["count"] += 1
+                continue
         out[op] += _shape_bytes(shape_str)
         out["count"] += 1
     return out

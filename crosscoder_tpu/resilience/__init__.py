@@ -1,0 +1,30 @@
+"""Fault injection and automated recovery for long-lived training runs.
+
+The reference repo loses the entire run to a single NaN step, a corrupted
+save, or a stalled harvest — it cannot resume at all (SURVEY.md §5). The
+TPU port's clean-exit machinery (atomic saves, SIGTERM flush, coordinated
+multihost stop) covers *orderly* failures; this package closes the loop on
+the disorderly ones:
+
+- :mod:`crosscoder_tpu.resilience.chaos` — deterministic, seed-driven
+  fault injection (NaN batches, corrupted checkpoint artifacts, stalled
+  or excepting harvests), enabled only via ``cfg.chaos`` / the
+  ``CROSSCODER_CHAOS`` env var so production paths pay zero cost;
+- :mod:`crosscoder_tpu.resilience.watchdog` — timeout + exponential-
+  backoff retry around the data pipeline's serve/harvest calls;
+- the divergence guard + rollback lives in
+  :class:`crosscoder_tpu.train.trainer.Trainer` (``cfg.guard_loss``) and
+  verified checkpoint restore in
+  :class:`crosscoder_tpu.checkpoint.ckpt.Checkpointer` (per-artifact
+  SHA-256 checksums, fallback to the previous intact save, keep-last-k
+  retention via ``cfg.keep_saves``).
+
+Recovery is observable through the ``resilience/*`` counters
+(:class:`crosscoder_tpu.utils.logging.ResilienceCounters`). Fault model,
+rollback semantics, and chaos-spec grammar: ``docs/resilience.md``.
+"""
+
+from crosscoder_tpu.resilience.chaos import Chaos, ChaosFault
+from crosscoder_tpu.resilience.watchdog import Watchdog, WatchdogTimeout
+
+__all__ = ["Chaos", "ChaosFault", "Watchdog", "WatchdogTimeout"]

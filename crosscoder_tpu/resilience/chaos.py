@@ -1,0 +1,222 @@
+"""Deterministic fault injection for the training stack.
+
+A :class:`Chaos` object is a set of pre-planned faults keyed by monotone
+event counters (serve index, harvest index, save version), so a given spec
+produces the identical fault sequence on every run — chaos tests are
+ordinary deterministic tests. Each planned fault fires **exactly once**:
+after a rollback rewinds the step counter, the replayed window is clean,
+which models the transient faults (bad batch, bit flip, hung RPC) this
+subsystem exists to absorb — a fault that reproduces on every replay is a
+software bug and is *supposed* to exhaust the retry budget and abort.
+
+Injection points (each gated on ``chaos is not None`` at the call site, so
+the production paths pay a no-op attribute check at most):
+
+- ``poison_batch`` / ``on_serve`` — the trainer's batch-production path:
+  overwrite one row of a chosen serve's batch with NaN/Inf, stall the
+  serve for a configured duration, or raise :class:`ChaosFault`;
+- ``on_harvest`` — the buffer's harvest-chunk dispatch: stall or raise,
+  by harvest-chunk index;
+- ``corrupt_save`` — the checkpointer's writer, after a save's meta marker
+  lands: truncate or byte-flip one artifact of a chosen save version.
+
+Enable via ``cfg.chaos`` or the ``CROSSCODER_CHAOS`` env var with a
+comma-separated spec (see :meth:`Chaos.parse`), e.g.::
+
+    nan@5,corrupt-save@0:weights,stall@12:2.5,seed=7
+
+Grammar (``N`` = event index, ``SEC`` = float seconds):
+
+- ``nan@N`` / ``inf@N``     — poison the batch of serve N
+- ``stall@N[:SEC]``         — stall serve N (default 30 s)
+- ``fail@N``                — raise ChaosFault at serve N
+- ``stall-harvest@N[:SEC]`` — stall harvest chunk N
+- ``fail-harvest@N``        — raise ChaosFault at harvest chunk N
+- ``corrupt-save@V[:KIND]`` — corrupt save version V's artifact; KIND in
+  ``weights`` (default) | ``state`` | ``cfg`` | ``meta``
+- ``mode=truncate|flipbyte`` — corruption mode (default truncate)
+- ``seed=N``                — seed for the deterministic flip offset
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+_ARTIFACTS = {
+    "weights": "{v}.npz",
+    "state": "{v}_train_state.npz",
+    "cfg": "{v}_cfg.json",
+    "meta": "{v}_meta.json",
+}
+
+_DEFAULT_STALL_S = 30.0
+
+
+class ChaosFault(RuntimeError):
+    """The exception an injected ``fail@``/``fail-harvest@`` fault raises."""
+
+
+class Chaos:
+    """Planned fault schedule + the fire-once state machine around it."""
+
+    def __init__(
+        self,
+        nan_serves: tuple[int, ...] = (),
+        inf_serves: tuple[int, ...] = (),
+        stall_serves: dict[int, float] | None = None,
+        fail_serves: tuple[int, ...] = (),
+        stall_harvests: dict[int, float] | None = None,
+        fail_harvests: tuple[int, ...] = (),
+        corrupt_saves: dict[int, str] | None = None,
+        corrupt_mode: str = "truncate",
+        seed: int = 0,
+    ) -> None:
+        if corrupt_mode not in ("truncate", "flipbyte"):
+            raise ValueError(f"corrupt_mode must be truncate|flipbyte, got {corrupt_mode!r}")
+        for kind in (corrupt_saves or {}).values():
+            if kind not in _ARTIFACTS:
+                raise ValueError(
+                    f"corrupt-save artifact kind must be one of "
+                    f"{sorted(_ARTIFACTS)}, got {kind!r}"
+                )
+        self.nan_serves = tuple(nan_serves)
+        self.inf_serves = tuple(inf_serves)
+        self.stall_serves = dict(stall_serves or {})
+        self.fail_serves = tuple(fail_serves)
+        self.stall_harvests = dict(stall_harvests or {})
+        self.fail_harvests = tuple(fail_harvests)
+        self.corrupt_saves = dict(corrupt_saves or {})
+        self.corrupt_mode = corrupt_mode
+        self.seed = seed
+        # fire-once bookkeeping; hooks run on the train loop, the prefetch
+        # worker, the watchdog executor, and the checkpoint writer thread
+        self._lock = threading.Lock()
+        self._fired: set[tuple[str, int]] = set()
+        self._harvest_count = 0
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def parse(cls, spec: str | None) -> "Chaos | None":
+        """Spec string → Chaos; empty/None → None (chaos fully disabled)."""
+        if not spec or not spec.strip():
+            return None
+        kw: dict[str, Any] = {
+            "nan_serves": [], "inf_serves": [], "stall_serves": {},
+            "fail_serves": [], "stall_harvests": {}, "fail_harvests": [],
+            "corrupt_saves": {},
+        }
+        for raw in spec.split(","):
+            tok = raw.strip()
+            if not tok:
+                continue
+            if tok.startswith("mode="):
+                kw["corrupt_mode"] = tok[len("mode="):]
+                continue
+            if tok.startswith("seed="):
+                kw["seed"] = int(tok[len("seed="):])
+                continue
+            if "@" not in tok:
+                raise ValueError(f"bad chaos token {tok!r} (expected kind@index)")
+            kind, _, arg = tok.partition("@")
+            idx_s, _, extra = arg.partition(":")
+            idx = int(idx_s)
+            if kind == "nan":
+                kw["nan_serves"].append(idx)
+            elif kind == "inf":
+                kw["inf_serves"].append(idx)
+            elif kind == "stall":
+                kw["stall_serves"][idx] = float(extra) if extra else _DEFAULT_STALL_S
+            elif kind == "fail":
+                kw["fail_serves"].append(idx)
+            elif kind == "stall-harvest":
+                kw["stall_harvests"][idx] = float(extra) if extra else _DEFAULT_STALL_S
+            elif kind == "fail-harvest":
+                kw["fail_harvests"].append(idx)
+            elif kind == "corrupt-save":
+                kw["corrupt_saves"][idx] = extra or "weights"
+            else:
+                raise ValueError(f"unknown chaos fault kind {kind!r} in {tok!r}")
+        kw["nan_serves"] = tuple(kw["nan_serves"])
+        kw["inf_serves"] = tuple(kw["inf_serves"])
+        kw["fail_serves"] = tuple(kw["fail_serves"])
+        kw["fail_harvests"] = tuple(kw["fail_harvests"])
+        return cls(**kw)
+
+    @classmethod
+    def from_cfg_env(cls, cfg) -> "Chaos | None":
+        """The production wiring point: ``cfg.chaos``, else the
+        ``CROSSCODER_CHAOS`` env var, else None."""
+        import os
+
+        return cls.parse(getattr(cfg, "chaos", "") or os.environ.get("CROSSCODER_CHAOS", ""))
+
+    # ------------------------------------------------------------------
+    def _fire(self, kind: str, idx: int) -> bool:
+        """True exactly once per (kind, idx); thread-safe."""
+        key = (kind, idx)
+        with self._lock:
+            if key in self._fired:
+                return False
+            self._fired.add(key)
+            return True
+
+    # --- serve-path hooks (trainer batch production) -------------------
+    def on_serve(self, serve: int) -> None:
+        """Stall or raise at the start of serve ``serve`` (before the
+        buffer's state is touched, so a retry after the fault is safe)."""
+        if serve in self.stall_serves and self._fire("stall_serve", serve):
+            time.sleep(self.stall_serves[serve])
+        if serve in self.fail_serves and self._fire("fail_serve", serve):
+            raise ChaosFault(f"chaos: injected failure at serve {serve}")
+
+    def poison_batch(self, batch: Any, serve: int) -> Any:
+        """Overwrite row 0 of serve ``serve``'s batch with NaN/Inf."""
+        bad = None
+        if serve in self.nan_serves and self._fire("nan", serve):
+            bad = float("nan")
+        elif serve in self.inf_serves and self._fire("inf", serve):
+            bad = float("inf")
+        if bad is None:
+            return batch
+        if isinstance(batch, np.ndarray):
+            batch = np.array(batch, copy=True)
+            batch[0] = bad
+            return batch
+        # device-resident batch (HBM replay store): poison on device
+        import jax.numpy as jnp
+
+        return batch.at[0].set(jnp.asarray(bad, batch.dtype))
+
+    # --- harvest-path hook (buffer chunk dispatch) ----------------------
+    def on_harvest(self) -> None:
+        """Stall or raise by harvest-chunk index (internal monotone count)."""
+        with self._lock:
+            n = self._harvest_count
+            self._harvest_count += 1
+        if n in self.stall_harvests and self._fire("stall_harvest", n):
+            time.sleep(self.stall_harvests[n])
+        if n in self.fail_harvests and self._fire("fail_harvest", n):
+            raise ChaosFault(f"chaos: injected failure at harvest chunk {n}")
+
+    # --- checkpoint-path hook (writer, after meta lands) ----------------
+    def corrupt_save(self, save_dir: str | Path, v: int) -> None:
+        """Corrupt one artifact of save ``v`` on disk, per the plan."""
+        kind = self.corrupt_saves.get(v)
+        if kind is None or not self._fire("corrupt", v):
+            return
+        path = Path(save_dir) / _ARTIFACTS[kind].format(v=v)
+        data = path.read_bytes()
+        if self.corrupt_mode == "truncate":
+            path.write_bytes(data[: len(data) // 2])
+        else:  # flipbyte
+            off = int(np.random.default_rng(self.seed + v).integers(0, max(len(data), 1)))
+            flipped = bytearray(data)
+            flipped[off] ^= 0xFF
+            path.write_bytes(bytes(flipped))
+        print(f"[crosscoder_tpu] chaos: corrupted ({self.corrupt_mode}) "
+              f"{path.name} of save {v}", flush=True)

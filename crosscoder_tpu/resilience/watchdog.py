@@ -1,0 +1,119 @@
+"""Timeout + exponential-backoff retry around the data pipeline.
+
+At pod scale a harvest can hang (a wedged device RPC, a stuck remote
+filesystem) or fail transiently (a flaky host). The reference — and the
+port's plain serve path — would block the train loop forever or die on the
+first exception. :class:`Watchdog` wraps the serve/harvest call with two
+distinct recovery behaviors, chosen by how the fault presents:
+
+- **Exception** → real retry: the call raised, so the pipeline is
+  quiescent again; re-invoke after an exponentially-backed-off sleep
+  (``backoff_s · 2^attempt``), up to ``retries`` times, then re-raise.
+- **Timeout** → escalating patience, NOT a concurrent retry: the stalled
+  call may still be running in its worker thread and *will touch shared
+  pipeline state when it wakes*, so launching a second call alongside it
+  would race the buffer's serve pointer and cycle accounting. Instead the
+  watchdog logs the stall (``resilience/<name>_timeouts``), doubles its
+  wait, and keeps waiting — a stall that clears (preemptible-VM hiccup,
+  chaos-injected sleep) resumes transparently; one that never clears
+  exhausts the patience budget and raises :class:`WatchdogTimeout` loudly
+  rather than hanging the run silently forever.
+
+Every detection bumps a :class:`~crosscoder_tpu.utils.logging.ResilienceCounters`
+channel so recovery shows up in the metrics stream.
+
+Multi-process note: retries re-dispatch device programs at host-local
+times, which violates the SPMD cross-host dispatch-order requirement
+(see :mod:`crosscoder_tpu.parallel.multihost`) — the trainer disables the
+watchdog on multi-process meshes for the same reason it disables prefetch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+from crosscoder_tpu.utils.logging import ResilienceCounters
+
+
+class WatchdogTimeout(TimeoutError):
+    """A watched call stalled past the full escalation budget."""
+
+
+class Watchdog:
+    def __init__(
+        self,
+        timeout_s: float,
+        retries: int = 3,
+        backoff_s: float = 0.5,
+        name: str = "harvest",
+        counters: ResilienceCounters | None = None,
+    ) -> None:
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.name = name
+        self.counters = counters if counters is not None else ResilienceCounters()
+
+    def call(self, fn: Callable[[], Any]) -> Any:
+        """Run ``fn`` under watch; returns its result or raises after the
+        retry/patience budget is spent.
+
+        Each invocation runs on a fresh DAEMON thread (not an executor
+        pool: pool threads are joined at interpreter exit, so one
+        permanently stalled call would block process shutdown forever —
+        exactly the hang this class exists to escape)."""
+        attempt = 0
+        while True:
+            outcome: dict[str, Any] = {}
+            done = threading.Event()
+
+            def runner() -> None:
+                try:
+                    outcome["value"] = fn()
+                except BaseException as e:
+                    outcome["error"] = e
+                finally:
+                    done.set()
+
+            threading.Thread(
+                target=runner, name=f"watchdog-{self.name}", daemon=True
+            ).start()
+            patience = self.timeout_s
+            extensions = 0
+            # stall watch: wait-with-escalation until the call finishes.
+            # (done-ness is observed separately from the call's outcome so
+            # an fn that raises TimeoutError itself still takes the retry
+            # path, not the stall path.)
+            while not done.wait(timeout=patience):
+                if extensions >= self.retries:
+                    raise WatchdogTimeout(
+                        f"{self.name} stalled: no result after "
+                        f"{extensions + 1} waits (last {patience:.1f}s); "
+                        f"aborting rather than hanging the run"
+                    )
+                extensions += 1
+                self.counters.bump(f"{self.name}_timeouts")
+                print(f"[crosscoder_tpu] watchdog: {self.name} stall "
+                      f"#{extensions} (waited {patience:.1f}s); "
+                      f"extending wait", flush=True)
+                patience *= 2
+            err = outcome.get("error")
+            if err is None:
+                return outcome["value"]
+            if attempt >= self.retries:
+                raise err
+            attempt += 1
+            delay = self.backoff_s * 2 ** (attempt - 1)
+            self.counters.bump(f"{self.name}_retries")
+            print(f"[crosscoder_tpu] watchdog: {self.name} failed "
+                  f"({type(err).__name__}: {err}); retry {attempt}/"
+                  f"{self.retries} in {delay:.2f}s", flush=True)
+            time.sleep(delay)
+
+    def close(self) -> None:
+        """Kept for symmetry with other pipeline objects; daemon threads
+        need no teardown and never block process exit."""

@@ -25,7 +25,9 @@ from crosscoder_tpu.train.trainer import Trainer
 from crosscoder_tpu.utils.logging import MetricsLogger
 
 
-def build_buffer(cfg: CrossCoderConfig, mesh) -> tuple[Any, CrossCoderConfig]:
+def build_buffer(
+    cfg: CrossCoderConfig, mesh, chaos: Any | None = None
+) -> tuple[Any, CrossCoderConfig]:
     """Data source per ``cfg.data_source``; returns (buffer, cfg) with
     ``d_in`` injected from the loaded model (reference train.py:38-40)."""
     if cfg.data_source == "synthetic":
@@ -63,6 +65,7 @@ def build_buffer(cfg: CrossCoderConfig, mesh) -> tuple[Any, CrossCoderConfig]:
         cfg, lm_cfg, params_list, tokens,
         batch_sharding=NamedSharding(mesh, P("data", None)),
         lazy=cfg.resume,   # resume restores calibration + refills once, in restore()
+        chaos=chaos,       # harvest-level fault injection (None in production)
     )
     return buffer, cfg
 
@@ -78,14 +81,27 @@ def main(argv: list[str] | None = None) -> Trainer:
     mesh = mesh_lib.mesh_from_cfg(cfg)
     if distributed:
         print(f"[crosscoder_tpu] multihost: {multihost.process_info()}")
-    buffer, cfg = build_buffer(cfg, mesh)
+    # fault injection (cfg.chaos / CROSSCODER_CHAOS env): None unless a
+    # chaos spec was explicitly configured — production runs construct no
+    # chaos objects and every hook site stays a no-op is-None check
+    from crosscoder_tpu.resilience.chaos import Chaos
+
+    chaos = Chaos.from_cfg_env(cfg)
+    if chaos is not None:
+        import os
+
+        print(f"[crosscoder_tpu] CHAOS ENABLED: "
+              f"{(cfg.chaos or os.environ.get('CROSSCODER_CHAOS', ''))!r}",
+              flush=True)
+    buffer, cfg = build_buffer(cfg, mesh, chaos=chaos)
     trainer = Trainer(
         cfg, buffer, mesh=mesh,
         # logging is a process-0 singleton; the checkpointer exists on every
         # process (restore must run SPMD on all hosts or params diverge) and
         # gates its writes on the primary itself
         logger=MetricsLogger(cfg) if multihost.is_primary() else None,
-        checkpointer=Checkpointer(cfg=cfg),
+        checkpointer=Checkpointer(cfg=cfg, chaos=chaos),
+        chaos=chaos,
     )
     if cfg.resume:
         meta = trainer.restore()

@@ -28,6 +28,7 @@ from __future__ import annotations
 import concurrent.futures
 import contextlib
 import functools
+import math
 import threading
 import types
 from typing import Any, Callable
@@ -43,7 +44,7 @@ from crosscoder_tpu.models import crosscoder as cc
 from crosscoder_tpu.parallel import mesh as mesh_lib
 from crosscoder_tpu.train import schedules
 from crosscoder_tpu.train.state import TrainState, init_train_state, make_optimizer
-from crosscoder_tpu.utils.logging import MetricsLogger, source_tag
+from crosscoder_tpu.utils.logging import MetricsLogger, ResilienceCounters, source_tag
 
 
 def make_train_step(
@@ -186,6 +187,7 @@ class Trainer:
         mesh=None,
         logger: MetricsLogger | None = None,
         checkpointer: Any | None = None,
+        chaos: Any | None = None,
     ) -> None:
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else mesh_lib.mesh_from_cfg(cfg)
@@ -197,6 +199,35 @@ class Trainer:
         self.logger = logger
         self.checkpointer = checkpointer
         self.total_steps = cfg.total_steps
+        # --- resilience (docs/resilience.md) ---------------------------
+        # chaos: fault-injection hooks on the batch-production path; None
+        # (default and all production configs) costs one is-None check
+        self.chaos = chaos
+        # recovery counters, shared with the checkpointer so its corrupt-
+        # artifact skips land in the same resilience/* metric channel
+        self.resilience = ResilienceCounters()
+        if checkpointer is not None and getattr(checkpointer, "counters", None) is None:
+            checkpointer.counters = self.resilience
+        self._serve_count = 0       # monotone batch-production index (chaos keys)
+        self._rollbacks = 0         # divergence rollbacks this Trainer
+        self._loss_ref: float | None = None   # last healthy logged loss
+        self._watchdog = None
+        if cfg.harvest_timeout_s > 0:
+            if jax.process_count() > 1:
+                # watchdog retries re-dispatch device programs at host-
+                # local times — the same SPMD dispatch-order violation
+                # that disables prefetch below
+                print("[crosscoder_tpu] harvest watchdog disabled on a "
+                      "multi-process mesh (retries would desync cross-host "
+                      "dispatch order)", flush=True)
+            else:
+                from crosscoder_tpu.resilience.watchdog import Watchdog
+
+                self._watchdog = Watchdog(
+                    cfg.harvest_timeout_s, retries=cfg.harvest_retries,
+                    backoff_s=cfg.harvest_backoff_s, name="harvest",
+                    counters=self.resilience,
+                )
 
         self._tx = tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
         state = init_train_state(jax.random.key(cfg.seed), cfg, tx)
@@ -291,17 +322,35 @@ class Trainer:
             self._scale_src = vec.copy()
         return self._scale_dev
 
+    def _serve_once(self, serve: int) -> Any:
+        """One buffer serve, with the chaos hooks around it (both no-ops
+        unless a chaos plan was injected — tests/staging only)."""
+        if self.chaos is not None:
+            self.chaos.on_serve(serve)
+        if hasattr(self.buffer, "next_raw"):
+            batch = self.buffer.next_raw()
+        else:
+            batch = self.buffer.next()
+        if self.chaos is not None:
+            batch = self.chaos.poison_batch(batch, serve)
+        return batch
+
     def _produce_batch(self) -> tuple[jax.Array, jax.Array]:
         """Gather the next batch and start its host→device transfer.
 
         Runs on the prefetch worker when prefetching is on. Raw-bf16 serving
         (``next_raw``) is preferred: the norm factors ride separately and are
-        applied inside the compiled step.
+        applied inside the compiled step. With ``cfg.harvest_timeout_s``
+        set, the serve runs under the watchdog (stall detection + backoff
+        retry of exceptions; chaos faults raise/stall at the serve's entry,
+        before buffer state moves, so a retried serve is safe).
         """
-        if hasattr(self.buffer, "next_raw"):
-            batch = self.buffer.next_raw()
+        serve = self._serve_count
+        self._serve_count += 1
+        if self._watchdog is not None:
+            batch = self._watchdog.call(lambda: self._serve_once(serve))
         else:
-            batch = self.buffer.next()
+            batch = self._serve_once(serve)
         with self._dispatch_lock:
             return jax.device_put(batch, self._batch_sharding), self._device_scale()
 
@@ -357,6 +406,8 @@ class Trainer:
             self._prefetch_pool.shutdown(wait=True)
             self._prefetch_pool = None
             self._pending = None
+        if self._watchdog is not None:
+            self._watchdog.close()
         if self.checkpointer is not None and hasattr(self.checkpointer, "wait"):
             # land any background checkpoint write before process exit
             self.checkpointer.wait()
@@ -409,7 +460,105 @@ class Trainer:
 
     def log(self, metrics: dict[str, Any], step: int) -> None:
         if self.logger is not None:
-            self.logger.log(expand_metrics(metrics, self.cfg.n_sources), step)
+            scalars = expand_metrics(metrics, self.cfg.n_sources)
+            # resilience/* counters ride along only when a recovery has
+            # actually happened (snapshot of an untouched instance is {}),
+            # so fault-free runs log exactly the reference's scalar surface
+            scalars.update(self.resilience.snapshot())
+            self.logger.log(scalars, step)
+
+    # --- divergence guard + rollback (cfg.guard_loss; docs/resilience.md) --
+
+    def _loss_diverged(self, loss_val: float) -> bool:
+        """Divergence test on the loss the log step ALREADY fetched — the
+        guard adds no host sync anywhere. Non-finite always diverges; a
+        finite loss diverges when it spikes past ``cfg.loss_spike_factor``
+        × the last healthy logged loss (None right after start/rollback,
+        so the first log of each stretch re-establishes the reference)."""
+        if not math.isfinite(loss_val):
+            return True
+        ref = self._loss_ref
+        if ref is not None and loss_val > self.cfg.loss_spike_factor * max(ref, 1e-12):
+            return True
+        self._loss_ref = loss_val
+        return False
+
+    def _params_finite(self) -> bool:
+        """All-finite check of the (restored) params — a device sync, used
+        only inside rollback, never on the step fast path."""
+        return all(
+            bool(jnp.all(jnp.isfinite(v.astype(jnp.float32))))
+            for v in self.state.params.values()
+        )
+
+    def _rollback(self, detect_step: int) -> None:
+        """Recover from a diverged step: restore the newest intact save
+        whose params are finite (a save can itself carry poisoned state if
+        the NaN landed just before it fired), then skip the poisoned data
+        window — the batches between the restored step and the detection
+        point are consumed unserved, so the retrained stretch runs on
+        fresh data past the fault instead of replaying it. Bounded by
+        ``cfg.max_rollbacks`` per train(); exhausting the budget aborts
+        loudly (a fault that reproduces past the skipped window is a bug,
+        not a transient)."""
+        cfg = self.cfg
+        self._rollbacks += 1
+        if self._rollbacks > cfg.max_rollbacks:
+            raise RuntimeError(
+                f"loss diverged at step {detect_step} and the rollback "
+                f"budget (max_rollbacks={cfg.max_rollbacks}) is exhausted; "
+                f"aborting. resilience counters: {self.resilience.snapshot()}"
+            )
+        if self.checkpointer is None:
+            raise RuntimeError(
+                f"loss diverged at step {detect_step} but the trainer has "
+                "no checkpointer to roll back to"
+            )
+        self.resilience.bump("rollbacks")
+        print(f"[crosscoder_tpu] divergence at step {detect_step}: rolling "
+              f"back ({self._rollbacks}/{cfg.max_rollbacks})", flush=True)
+        meta = self.restore()   # newest checksum-verified save
+        cand_v = meta["save_version"]
+        while not self._params_finite():
+            self.resilience.bump("poisoned_save_skips")
+            vdir = self.checkpointer.save_dir
+            older = sorted(
+                s for s in self.checkpointer.complete_saves(vdir) if s < cand_v
+            )
+            restored = False
+            while older and not restored:
+                cand_v = older.pop()          # newest remaining first
+                try:
+                    meta = self.restore(version_dir=vdir, save=cand_v)
+                    restored = True
+                except (ValueError, FileNotFoundError):
+                    continue                  # corrupt/torn: try older
+            if not restored:
+                raise RuntimeError(
+                    f"divergence rollback found no intact save with finite "
+                    f"params under {vdir}; aborting"
+                )
+        # branch truncation: saves newer than the one restored may carry
+        # the poisoned state this rollback escaped — a later auto-resume
+        # must not pick them
+        if hasattr(self.checkpointer, "discard_saves_after"):
+            self.checkpointer.discard_saves_after(
+                self.checkpointer.save_dir, cand_v
+            )
+        # skip the poisoned window: the serves covering (restored_step,
+        # detect_step] are consumed and discarded, so the fault's batch
+        # never reaches a step again
+        n_skip = max(0, detect_step + 1 - self.step_counter)
+        for _ in range(n_skip):
+            serve = self._serve_count
+            self._serve_count += 1
+            self._serve_once(serve)
+        if n_skip:
+            self.resilience.bump("skipped_batches", n_skip)
+        self._loss_ref = None   # re-establish the spike reference fresh
+        print(f"[crosscoder_tpu] rolled back to step {self.step_counter} "
+              f"(save {cand_v}), skipped {n_skip} poisoned batches",
+              flush=True)
 
     def _final_save_agreed(self, clean: bool) -> bool:
         """All-processes-clean agreement for the final collective save,
@@ -503,16 +652,23 @@ class Trainer:
         SIGTERM — the preemption notice on TPU VMs/pods — is caught for the
         duration of the loop and triggers a clean stop: finish the current
         step, write a resumable checkpoint, exit. A second SIGTERM falls
-        through to the previous handler."""
+        through to the previous handler.
+
+        Divergence recovery (``cfg.guard_loss``; docs/resilience.md): at
+        each log step the already-fetched loss is checked for non-finite
+        values or a ``cfg.loss_spike_factor`` spike; on divergence the
+        trainer restores the last intact finite checkpoint, skips the
+        poisoned data window, and re-enters the loop at the restored step
+        — bounded by ``cfg.max_rollbacks`` before aborting loudly. With
+        the guard off (default) the loop body is unchanged and no host
+        sync is added anywhere."""
         import signal
         import time
 
         num_steps = self.total_steps if num_steps is None else num_steps
         metrics: dict[str, Any] = {}
-        start = self.step_counter  # nonzero after restore()
-        progress = _progress_bar(start, num_steps)
+        guard = self.cfg.guard_loss
         profiling = False
-        last_log_t, last_log_i = time.perf_counter(), start
 
         stop_requested = False
         prev_handler = None
@@ -557,30 +713,60 @@ class Trainer:
             prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
         clean = False
         try:
-            for i in progress:
-                if _stop_agreed(i):
+            if (guard and self.checkpointer is not None
+                    and self.checkpointer.save_version == 0):
+                # baseline save: the guard's first rollback must have an
+                # intact save to land on even if divergence hits before
+                # the first periodic save
+                self.save()
+            # outer retry loop: one iteration per training stretch — the
+            # whole run when nothing diverges (guard off: exactly one
+            # iteration, with the identical per-step body as before), one
+            # extra iteration per rollback, re-entered at the restored step
+            while True:
+                rolled_back = False
+                start = self.step_counter  # nonzero after restore()/rollback
+                progress = _progress_bar(start, num_steps)
+                last_log_t, last_log_i = time.perf_counter(), start
+                for i in progress:
+                    if _stop_agreed(i):
+                        break
+                    if self.cfg.profile_dir and i == start + 10:
+                        jax.profiler.start_trace(self.cfg.profile_dir)
+                        profiling = True
+                    metrics = self.step(full_metrics=(i % self.cfg.log_every == 0))
+                    if profiling and i >= start + 14:
+                        float(jax.device_get(metrics["loss"]))
+                        jax.profiler.stop_trace()
+                        profiling = False
+                    if i % self.cfg.log_every == 0:
+                        # sync via a scalar fetch: block_until_ready is not an
+                        # execution barrier under remote-tunnel TPU clients
+                        loss_val = float(jax.device_get(metrics["loss"]))
+                        if guard and self._loss_diverged(loss_val):
+                            # the guard reuses the loss this log step just
+                            # fetched — detection itself adds no host sync
+                            if profiling:
+                                # end an active trace before the stretch
+                                # restarts, or the next start_trace raises
+                                # mid-recovery
+                                jax.profiler.stop_trace()
+                                profiling = False
+                            getattr(progress, "close", lambda: None)()
+                            self._rollback(i)
+                            rolled_back = True
+                            break
+                        now = time.perf_counter()
+                        metrics = dict(metrics)
+                        metrics["step_time_ms"] = 1000 * (now - last_log_t) / max(i - last_log_i, 1)
+                        last_log_t, last_log_i = now, i
+                        self.log(metrics, step=i)
+                    if (i + 1) % self.cfg.save_every == 0:
+                        # background: the file write overlaps subsequent steps;
+                        # only the device→host fetch blocks the loop
+                        self.save(background=True)
+                if not rolled_back:
                     break
-                if self.cfg.profile_dir and i == start + 10:
-                    jax.profiler.start_trace(self.cfg.profile_dir)
-                    profiling = True
-                metrics = self.step(full_metrics=(i % self.cfg.log_every == 0))
-                if profiling and i >= start + 14:
-                    float(jax.device_get(metrics["loss"]))
-                    jax.profiler.stop_trace()
-                    profiling = False
-                if i % self.cfg.log_every == 0:
-                    # sync via a scalar fetch: block_until_ready is not an
-                    # execution barrier under remote-tunnel TPU clients
-                    float(jax.device_get(metrics["loss"]))
-                    now = time.perf_counter()
-                    metrics = dict(metrics)
-                    metrics["step_time_ms"] = 1000 * (now - last_log_t) / max(i - last_log_i, 1)
-                    last_log_t, last_log_i = now, i
-                    self.log(metrics, step=i)
-                if (i + 1) % self.cfg.save_every == 0:
-                    # background: the file write overlaps subsequent steps;
-                    # only the device→host fetch blocks the loop
-                    self.save(background=True)
             clean = True
         finally:
             if in_main_thread:

@@ -21,11 +21,42 @@ explained_variance, explained_variance_A, explained_variance_B — with
 from __future__ import annotations
 
 import json
+import threading
 import time
 from pathlib import Path
 from typing import Any
 
 _LETTERS = "ABCDEFGH"
+
+
+class ResilienceCounters:
+    """Monotone recovery counters (``resilience/*`` metric channel).
+
+    The resilience subsystem (:mod:`crosscoder_tpu.resilience`) bumps these
+    from whichever thread detected/recovered a fault — the train loop
+    (rollbacks), the watchdog executor (harvest retries/timeouts), the
+    checkpoint restore path (corrupt-artifact skips) — so every recovery
+    is visible in the ordinary metrics stream instead of only in stderr.
+    ``snapshot`` returns the nonzero counters under ``resilience/<name>``
+    keys; an untouched instance snapshots to ``{}``, so runs with no
+    faults log exactly the reference's scalar surface.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def bump(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return {f"resilience/{k}": v for k, v in self._counts.items() if v}
 
 
 def source_tag(i: int) -> str:

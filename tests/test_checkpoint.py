@@ -117,6 +117,45 @@ def test_background_saves_serialize(tmp_path):
     assert not list(vdir.glob("*.tmp"))
 
 
+def test_background_save_snapshot_isolated_from_donated_steps(tmp_path, monkeypatch):
+    """The background writer must serialize the state AS FETCHED, not
+    views of live device buffers: on the CPU backend np.asarray(jax.Array)
+    can be zero-copy, and the donated train step reuses that memory — a
+    slow writer then records a LATER step's bytes under this save's meta
+    (observed live: train_state at step 10 under meta step 5, poisoned by
+    a NaN step in between). The writer is slowed here so any aliasing
+    deterministically loses the race."""
+    import time
+
+    import crosscoder_tpu.checkpoint.ckpt as ckpt_mod
+
+    real_savez = ckpt_mod._atomic_savez
+
+    def slow_savez(path, arrays):
+        time.sleep(0.3)                 # steps run while the write waits
+        return real_savez(path, arrays)
+
+    monkeypatch.setattr(ckpt_mod, "_atomic_savez", slow_savez)
+    cfg = tiny_cfg(tmp_path)
+    ck = Checkpointer(cfg=cfg)
+    tr = Trainer(cfg, checkpointer=ck)
+    for _ in range(3):
+        tr.step()
+    tr.save(background=True)
+    for _ in range(10):
+        tr.step()                       # donated-state reuse during the write
+    ck.wait()
+    vdir = tmp_path / "version_0"
+    meta = json.loads((vdir / "0_meta.json").read_text())
+    assert meta["step"] == 3
+    with np.load(vdir / "0_train_state.npz") as z:
+        assert int(z[".step"]) == 3     # NOT a later step's state
+        state_wenc = z[".params['W_enc']"]
+    with np.load(vdir / "0.npz") as z:
+        np.testing.assert_array_equal(z["W_enc"], state_wenc.astype(np.float32))
+    tr.close()
+
+
 def test_torn_save_is_skipped(tmp_path):
     """A save whose meta (the completion marker, written last) is missing —
     a kill after the weights npz landed — must be invisible: restore picks

@@ -83,5 +83,34 @@ def test_shape_parser():
     out = comm_model.collective_bytes(hlo)
     assert out["all-reduce"] == 4096 * 2304 * 4
     assert out["all-gather"] == 16 * 8 * 2
-    assert out["collective-permute"] == 8 * 2 * 4 * 2  # start tuple, done skipped
+    # -start tuple is (operand_alias, result): only the RESULT half counts
+    # (summing the whole tuple overcounted async permutes ~2x), and the
+    # -done completion stays skipped
+    assert out["collective-permute"] == 8 * 2 * 4
     assert out["count"] == 3
+
+
+def test_async_start_result_half_only():
+    """Async all-gather: the -start tuple's operand and result DIFFER in
+    size — the result element (the gathered output), not the operand and
+    not the tuple sum, is what must be tallied."""
+    hlo = """
+  %ag-start = (f32[8,2]{1,0}, f32[64,2]{1,0}) all-gather-start(%x), dimensions={0}
+  %ag-done = f32[64,2]{1,0} all-gather-done(%ag-start)
+"""
+    out = comm_model.collective_bytes(hlo)
+    assert out["all-gather"] == 64 * 2 * 4
+    assert out["count"] == 1
+
+
+def test_variadic_all_reduce_start_counts_every_result():
+    """A combined variadic all-reduce-start's tuple holds ONLY results (no
+    operand alias, unlike permute/all-gather) — every element must count,
+    or combined gradient psums are undercounted."""
+    hlo = """
+  %ar-start = (f32[1024]{0}, f32[2048]{0}) all-reduce-start(%a, %b), replica_groups={}
+  %ar-done = (f32[1024]{0}, f32[2048]{0}) all-reduce-done(%ar-start)
+"""
+    out = comm_model.collective_bytes(hlo)
+    assert out["all-reduce"] == (1024 + 2048) * 4
+    assert out["count"] == 1

@@ -79,6 +79,36 @@ def test_training_loss_combines(setup):
     np.testing.assert_allclose(float(loss), float(losses.l2_loss + 2.0 * losses.l1_loss), rtol=1e-6)
 
 
+def test_training_loss_rejects_l1_coeff_cfg_mismatch(setup):
+    """The L1 term is compiled out when with_metrics=False AND
+    cfg.l1_coeff == 0 (the static gate in get_losses), but training_loss
+    multiplies the DYNAMIC l1_coeff argument — a direct caller passing a
+    nonzero runtime coefficient there would silently get loss = l2 +
+    coeff·0. Concretely-checkable disagreements must raise."""
+    _, params, x, _ = setup
+    cfg0 = small_cfg(l1_coeff=0.0)
+    with pytest.raises(ValueError, match="l1_coeff"):
+        cc.training_loss(params, jnp.asarray(x), 0.5, cfg0, with_metrics=False)
+    with pytest.raises(ValueError, match="l1_coeff"):
+        cc.training_loss(params, jnp.asarray(x), jnp.float32(0.5), cfg0,
+                         with_metrics=False)
+    with pytest.raises(ValueError, match="l1_coeff"):
+        # np.float32 is not a python-float subclass — still concrete
+        cc.training_loss(params, jnp.asarray(x), np.float32(0.5), cfg0,
+                         with_metrics=False)
+    # agreeing zero passes (the TopK regime this gate optimizes for) ...
+    loss0, _ = cc.training_loss(params, jnp.asarray(x), 0.0, cfg0,
+                                with_metrics=False)
+    assert np.isfinite(float(loss0))
+    # ... and a nonzero coeff against a nonzero cfg is the normal path
+    cfg1 = small_cfg(l1_coeff=2.0)
+    loss1, losses1 = cc.training_loss(params, jnp.asarray(x), 0.5, cfg1,
+                                      with_metrics=False)
+    np.testing.assert_allclose(
+        float(loss1), float(losses1.l2_loss + 0.5 * losses1.l1_loss), rtol=1e-6
+    )
+
+
 def test_generalized_n_models():
     # the reference hardcodes n_models=2 (crosscoder.py:32); we support any N
     cfg = small_cfg(n_models=3)

@@ -113,3 +113,27 @@ def test_sparsify_matches_mask():
         assert list(i[r][v[r] != 0]) == list(nz)
         assert np.array_equal(v[r][v[r] != 0], f[r][nz])
         assert np.all(v[r][len(nz):] == 0)
+
+
+def test_sparsify_wide_single_chunk_fits_vmem():
+    """Width 8064 (<= 8192 but not %2048): the single-chunk leg must shrink
+    its row block so the f32 scratch + input block stay inside the module's
+    VMEM budget — 256 rows at 8 B/element is 16.5 MB, which Mosaic refuses
+    to compile; the pre-fix geometry passed sparsify_supported and then
+    died at compile time for direct callers."""
+    width = 8064
+    assert topk_pallas.sparsify_supported(width, 8)
+    for itemsize in (4, 2):
+        rows = topk_pallas._sparsify_rows(width, 4096, itemsize)
+        assert rows % 32 == 0 and rows >= 32
+        working_set = rows * width * (4 + itemsize)
+        assert working_set <= topk_pallas._VMEM_BUDGET_BYTES, (rows, working_set)
+    # and the shrunk geometry still produces correct output (interpret mode)
+    h = jax.random.normal(jax.random.key(3), (64, width), jnp.float32)
+    f = np.asarray(jax.jit(lambda x: topk_pallas.topk(x, 8, True))(h))
+    vals, idx = topk_pallas.sparsify(jnp.asarray(f), 8, interpret=True)
+    v, i = np.asarray(vals), np.asarray(idx)
+    for r in range(f.shape[0]):
+        nz = np.nonzero(f[r])[0]
+        assert list(i[r][v[r] != 0]) == list(nz)
+        assert np.array_equal(v[r][v[r] != 0], f[r][nz])

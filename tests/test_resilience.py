@@ -1,0 +1,403 @@
+"""Chaos-driven tests of the resilience subsystem (docs/resilience.md):
+verified checkpoint restore with fallback, keep-last-k retention, the
+divergence guard + rollback, the data-pipeline watchdog, and the
+fast-path zero-cost guarantee. All CPU-only, tier-1."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from crosscoder_tpu.checkpoint import Checkpointer
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.resilience.chaos import Chaos, ChaosFault
+from crosscoder_tpu.resilience.watchdog import Watchdog, WatchdogTimeout
+from crosscoder_tpu.train.trainer import Trainer
+from crosscoder_tpu.utils.logging import ResilienceCounters
+
+
+def tiny_cfg(tmp_path, steps=20, **kw):
+    base = dict(
+        d_in=16,
+        dict_size=64,
+        batch_size=64,
+        num_tokens=64 * steps,
+        enc_dtype="fp32",
+        lr=1e-3,
+        l1_coeff=0.1,
+        log_backend="null",
+        checkpoint_dir=str(tmp_path),
+    )
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# chaos spec
+
+
+def test_chaos_spec_parse():
+    c = Chaos.parse("nan@5,inf@7,stall@3:1.5,fail@4,stall-harvest@2,"
+                    "fail-harvest@9,corrupt-save@1:state,mode=flipbyte,seed=7")
+    assert c.nan_serves == (5,) and c.inf_serves == (7,)
+    assert c.stall_serves == {3: 1.5} and c.fail_serves == (4,)
+    assert c.stall_harvests[2] > 0 and c.fail_harvests == (9,)
+    assert c.corrupt_saves == {1: "state"}
+    assert c.corrupt_mode == "flipbyte" and c.seed == 7
+    assert Chaos.parse("") is None and Chaos.parse(None) is None
+    assert Chaos.parse("corrupt-save@0").corrupt_saves == {0: "weights"}
+    with pytest.raises(ValueError, match="kind"):
+        Chaos.parse("explode@3")
+    with pytest.raises(ValueError, match="artifact kind"):
+        Chaos.parse("corrupt-save@0:nonsense")
+
+
+def test_chaos_faults_fire_exactly_once():
+    c = Chaos.parse("nan@2,fail@3")
+    b = np.ones((4, 2, 8), np.float32)
+    assert np.isnan(c.poison_batch(b, 2)[0]).all()
+    assert np.isfinite(c.poison_batch(b, 2)).all()   # second pass: clean
+    with pytest.raises(ChaosFault):
+        c.on_serve(3)
+    c.on_serve(3)                                     # fired: now a no-op
+
+
+# ---------------------------------------------------------------------------
+# verified restore
+
+
+def test_checksums_recorded_and_verified(tmp_path):
+    cfg = tiny_cfg(tmp_path)
+    tr = Trainer(cfg, checkpointer=Checkpointer(cfg=cfg))
+    tr.step()
+    tr.save()
+    vdir = tmp_path / "version_0"
+    meta = json.loads((vdir / "0_meta.json").read_text())
+    sums = meta["checksums"]
+    assert set(sums) == {"0.npz", "0_cfg.json", "0_train_state.npz"}
+    assert Checkpointer.verify_save(vdir, 0)
+    # bit-rot one artifact: verification must catch it
+    data = bytearray((vdir / "0_train_state.npz").read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    (vdir / "0_train_state.npz").write_bytes(bytes(data))
+    assert not Checkpointer.verify_save(vdir, 0)
+
+
+def test_corrupt_newest_save_falls_back(tmp_path):
+    """Truncate the newest save's weights artifact: restore must skip it
+    (counted) and land on the previous intact save."""
+    cfg = tiny_cfg(tmp_path)
+    ck = Checkpointer(cfg=cfg)
+    tr = Trainer(cfg, checkpointer=ck)
+    for _ in range(3):
+        tr.step()
+    tr.save()                 # save 0 at step 3
+    for _ in range(2):
+        tr.step()
+    tr.save()                 # save 1 at step 5
+    vdir = tmp_path / "version_0"
+    blob = (vdir / "1.npz").read_bytes()
+    (vdir / "1.npz").write_bytes(blob[: len(blob) // 2])
+
+    counters = ResilienceCounters()
+    ck2 = Checkpointer(base_dir=tmp_path, counters=counters)
+    tr2 = Trainer(cfg, checkpointer=ck2)
+    meta = tr2.restore()
+    assert meta["step"] == 3          # fell back past the corrupt save 1
+    assert counters.get("corrupt_artifact_skips") == 1
+    tr2.close()
+
+
+def test_chaos_corrupt_save_hook(tmp_path):
+    """The chaos layer corrupts a save as it lands (via the checkpointer's
+    own writer hook); restore falls back to the intact predecessor."""
+    cfg = tiny_cfg(tmp_path)
+    chaos = Chaos.parse("corrupt-save@1:state")
+    ck = Checkpointer(cfg=cfg, chaos=chaos)
+    tr = Trainer(cfg, checkpointer=ck, chaos=chaos)
+    tr.step()
+    tr.save()                 # save 0: intact
+    tr.step()
+    tr.save()                 # save 1: train_state truncated by chaos
+    assert not Checkpointer.verify_save(tmp_path / "version_0", 1)
+    tr2 = Trainer(cfg, checkpointer=Checkpointer(base_dir=tmp_path))
+    assert tr2.restore()["step"] == 1
+    tr2.close()
+
+
+def test_explicit_save_verifies_loudly(tmp_path):
+    cfg = tiny_cfg(tmp_path)
+    ck = Checkpointer(cfg=cfg)
+    tr = Trainer(cfg, checkpointer=ck)
+    tr.step()
+    tr.save()
+    vdir = tmp_path / "version_0"
+    blob = (vdir / "0.npz").read_bytes()
+    (vdir / "0.npz").write_bytes(blob[: len(blob) // 2])
+    tr2 = Trainer(cfg, checkpointer=Checkpointer(base_dir=tmp_path))
+    with pytest.raises(ValueError, match="checksum"):
+        tr2.restore(version_dir=vdir, save=0)
+    tr2.close()
+
+
+def test_keep_last_k_retention(tmp_path):
+    cfg = tiny_cfg(tmp_path, keep_saves=2)
+    ck = Checkpointer(cfg=cfg)
+    tr = Trainer(cfg, checkpointer=ck)
+    for _ in range(4):
+        tr.step()
+        tr.save()
+    vdir = tmp_path / "version_0"
+    assert Checkpointer.complete_saves(vdir) == [2, 3]
+    # pruned saves leave no artifacts behind
+    for v in (0, 1):
+        assert not list(vdir.glob(f"{v}_*")) and not (vdir / f"{v}.npz").exists()
+    tr2 = Trainer(cfg, checkpointer=Checkpointer(base_dir=tmp_path))
+    assert tr2.restore()["step"] == 4
+    tr2.close()
+
+
+def test_discard_saves_after_branch_truncation(tmp_path):
+    cfg = tiny_cfg(tmp_path)
+    ck = Checkpointer(cfg=cfg)
+    tr = Trainer(cfg, checkpointer=ck)
+    for _ in range(3):
+        tr.step()
+        tr.save()
+    vdir = tmp_path / "version_0"
+    ck.discard_saves_after(vdir, 0)
+    assert Checkpointer.complete_saves(vdir) == [0]
+    assert not (vdir / "2.npz").exists()
+
+
+# ---------------------------------------------------------------------------
+# divergence guard + rollback
+
+
+def test_nan_step_rolls_back_and_converges(tmp_path):
+    """Inject one NaN batch: the guard detects at the next log step, rolls
+    back to the last intact save, skips the poisoned window, and the run
+    still reaches its target step with finite, decreased loss."""
+    cfg = tiny_cfg(tmp_path, steps=30, log_every=3, save_every=5,
+                   guard_loss=True, max_rollbacks=3)
+    chaos = Chaos.parse("nan@11")
+    tr = Trainer(cfg, checkpointer=Checkpointer(cfg=cfg), chaos=chaos)
+    out = tr.train()
+    assert tr.step_counter == 30
+    assert np.isfinite(out["loss"])
+    assert tr.resilience.get("rollbacks") == 1
+    assert tr.resilience.get("skipped_batches") >= 1
+    # params finite after recovery
+    assert all(np.isfinite(np.asarray(v)).all()
+               for v in jax.device_get(tr.state.params).values())
+
+
+def test_rollback_during_active_profiler_trace(tmp_path):
+    """Divergence inside the profiling window (steps start+10..start+14):
+    the rollback must close the active trace before the new stretch
+    re-enters start_trace, or recovery dies on 'session already active'."""
+    cfg = tiny_cfg(tmp_path, steps=30, log_every=3, save_every=5,
+                   guard_loss=True, max_rollbacks=3,
+                   profile_dir=str(tmp_path / "trace"))
+    chaos = Chaos.parse("nan@11")   # NaN at step 11 -> detected at log 12,
+    tr = Trainer(cfg, checkpointer=Checkpointer(cfg=cfg), chaos=chaos)
+    out = tr.train()                # while the step-10..14 trace is live
+    assert tr.step_counter == 30
+    assert np.isfinite(out["loss"])
+    assert tr.resilience.get("rollbacks") == 1
+
+
+def test_rollback_budget_exhaustion_aborts(tmp_path):
+    """Faults outrunning max_rollbacks must abort loudly, not loop."""
+    cfg = tiny_cfg(tmp_path, steps=40, log_every=2, save_every=4,
+                   guard_loss=True, max_rollbacks=1)
+    # two distinct NaN serves, far enough apart that the second lands
+    # after the first rollback's skipped window
+    chaos = Chaos.parse("nan@9,nan@25")
+    tr = Trainer(cfg, checkpointer=Checkpointer(cfg=cfg), chaos=chaos)
+    with pytest.raises(RuntimeError, match="rollback budget"):
+        tr.train()
+    assert tr.resilience.get("rollbacks") == 1
+
+
+def test_loss_spike_detection_unit():
+    cfg = CrossCoderConfig(d_in=8, dict_size=16, guard_loss=True,
+                           loss_spike_factor=5.0, enc_dtype="fp32")
+    tr = Trainer(cfg)
+    assert not tr._loss_diverged(10.0)    # establishes the reference
+    assert not tr._loss_diverged(12.0)    # mild rise: healthy
+    assert tr._loss_diverged(float("nan"))
+    assert tr._loss_diverged(float("inf"))
+    assert tr._loss_diverged(12.0 * 6)    # > factor x last healthy
+    assert not tr._loss_diverged(12.0)    # reference unchanged by spikes
+
+
+def test_guard_config_validation():
+    with pytest.raises(ValueError, match="keep_saves"):
+        CrossCoderConfig(guard_loss=True, keep_saves=1)
+    with pytest.raises(ValueError, match="loss_spike_factor"):
+        CrossCoderConfig(loss_spike_factor=1.0)
+    with pytest.raises(ValueError, match="harvest_timeout_s"):
+        CrossCoderConfig(harvest_timeout_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+
+
+def test_watchdog_exception_backoff_retry():
+    counters = ResilienceCounters()
+    w = Watchdog(5.0, retries=2, backoff_s=0.01, counters=counters)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert w.call(flaky) == "ok"
+    assert counters.get("harvest_retries") == 2
+    with pytest.raises(RuntimeError, match="always"):
+        w.call(lambda: (_ for _ in ()).throw(RuntimeError("always")))
+
+
+def test_watchdog_stall_escalates_then_aborts():
+    counters = ResilienceCounters()
+    w = Watchdog(0.05, retries=1, backoff_s=0.01, counters=counters)
+    import time
+
+    # a stall shorter than the escalation budget: detected, then survives
+    assert w.call(lambda: (time.sleep(0.08), "late")[1]) == "late"
+    assert counters.get("harvest_timeouts") >= 1
+    # a stall that never clears: aborts loudly instead of hanging
+    with pytest.raises(WatchdogTimeout):
+        w.call(lambda: time.sleep(30))
+
+
+def test_stalled_serve_recovers_through_watchdog(tmp_path):
+    """A chaos-stalled serve under a short watchdog timeout: the stall is
+    detected (counted) and the run completes normally."""
+    cfg = tiny_cfg(tmp_path, steps=8, harvest_timeout_s=0.1,
+                   harvest_retries=4, harvest_backoff_s=0.05)
+    chaos = Chaos.parse("stall@3:0.25")
+    tr = Trainer(cfg, chaos=chaos)
+    out = tr.train()
+    assert tr.step_counter == 8
+    assert np.isfinite(out["loss"])
+    assert tr.resilience.get("harvest_timeouts") >= 1
+
+
+def test_failed_serve_retried_through_watchdog(tmp_path):
+    cfg = tiny_cfg(tmp_path, steps=8, harvest_timeout_s=5.0,
+                   harvest_retries=2, harvest_backoff_s=0.01)
+    chaos = Chaos.parse("fail@2")
+    tr = Trainer(cfg, chaos=chaos)
+    out = tr.train()
+    assert tr.step_counter == 8
+    assert np.isfinite(out["loss"])
+    assert tr.resilience.get("harvest_retries") == 1
+
+
+# ---------------------------------------------------------------------------
+# fast path: resilience off must add nothing
+
+
+def test_fast_path_device_transfer_count(monkeypatch):
+    """With every resilience feature at its default (off), the host loop
+    performs EXACTLY the transfers it always did: one loss fetch per log
+    step plus the final metrics fetch — the divergence guard piggybacks on
+    the log fetch and contributes zero additional host syncs."""
+    steps, log_every = 7, 3
+    cfg = CrossCoderConfig(d_in=16, dict_size=64, batch_size=64,
+                           num_tokens=64 * steps, enc_dtype="fp32",
+                           log_every=log_every, log_backend="null")
+    assert not cfg.guard_loss and cfg.harvest_timeout_s == 0 and not cfg.chaos
+    tr = Trainer(cfg)
+    fetches = []
+    real = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: (fetches.append(1), real(x))[1])
+    out = tr.train()
+    assert np.isfinite(out["loss"])
+    n_log_steps = sum(1 for i in range(steps) if i % log_every == 0)
+    assert len(fetches) == n_log_steps + 1, (len(fetches), n_log_steps)
+
+
+def test_jitted_step_is_independent_of_resilience_config():
+    """The compiled train step must not change when resilience features
+    are enabled — detection/recovery live entirely in the host loop. The
+    lowered HLO with guard+watchdog config on is byte-identical to the
+    default's."""
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train.trainer import make_train_step
+    import jax.numpy as jnp
+
+    texts = []
+    for extra in ({}, dict(guard_loss=True, loss_spike_factor=4.0,
+                           max_rollbacks=5, harvest_timeout_s=2.0,
+                           keep_saves=3)):
+        cfg = CrossCoderConfig(d_in=8, dict_size=32, batch_size=32,
+                               enc_dtype="fp32", **extra)
+        mesh = mesh_lib.make_mesh(devices=jax.devices()[:1])
+        tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+        state = jax.eval_shape(lambda k: init_train_state(k, cfg, tx),
+                               jax.random.key(0))
+        shardings = mesh_lib.state_shardings(mesh, state, cfg.shard_sources)
+        step = make_train_step(cfg, mesh, tx, shardings)
+        state_sh = jax.tree_util.tree_map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            state, shardings,
+        )
+        batch = jax.ShapeDtypeStruct(
+            (cfg.batch_size, cfg.n_sources, cfg.d_in), jnp.float32,
+            sharding=mesh_lib.batch_sharding(mesh),
+        )
+        scale = jax.ShapeDtypeStruct(
+            (cfg.n_sources,), jnp.float32,
+            sharding=jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()
+            ),
+        )
+        texts.append(step.lower(state_sh, batch, scale).as_text())
+    assert texts[0] == texts[1]
+
+
+# ---------------------------------------------------------------------------
+# the full loop: every fault class in one short run
+
+
+def test_integration_survives_corruption_nan_and_stall(tmp_path):
+    """Acceptance: with fault injection enabled, one short run survives
+    (a) truncation of the newest checkpoint artifact, (b) one injected
+    NaN step, and (c) one stalled harvest — reaching its target step with
+    finite loss and resilience/* counters reflecting each recovery."""
+    cfg = tiny_cfg(tmp_path, steps=30, log_every=3, save_every=5,
+                   guard_loss=True, max_rollbacks=3, keep_saves=3,
+                   harvest_timeout_s=0.15, harvest_retries=4,
+                   harvest_backoff_s=0.05)
+    # save 2 lands at step 10 and is corrupted as it lands; the NaN batch
+    # at serve 11 diverges the loss right after — rollback must skip the
+    # corrupt newest save and land on the intact save 1 (step 5); the
+    # serve-3 stall exercises the watchdog on the way
+    chaos = Chaos.parse("stall@3:0.35,nan@11,corrupt-save@2:state")
+    ck = Checkpointer(cfg=cfg, chaos=chaos)
+    tr = Trainer(cfg, checkpointer=ck, chaos=chaos)
+    out = tr.train()
+
+    assert tr.step_counter == 30
+    assert np.isfinite(out["loss"])
+    snap = tr.resilience.snapshot()
+    assert snap.get("resilience/rollbacks", 0) >= 1, snap
+    assert snap.get("resilience/harvest_timeouts", 0) >= 1, snap
+    assert snap.get("resilience/corrupt_artifact_skips", 0) >= 1, snap
+    assert snap.get("resilience/skipped_batches", 0) >= 1, snap
+    # params finite, and the run is resumable from what's on disk
+    assert all(np.isfinite(np.asarray(v)).all()
+               for v in jax.device_get(tr.state.params).values())
+    tr2 = Trainer(cfg, checkpointer=Checkpointer(base_dir=tmp_path))
+    assert tr2.restore()["step"] > 0
+    tr2.close()

@@ -114,6 +114,28 @@ def test_composite_wide_width_oracle():
     kept = np.count_nonzero(out[0] != 0) + np.isnan(out[0]).sum()
     assert kept >= 7, kept
 
+    # SIGN-BIT-SET (negative-payload) NaN, bf16 pattern 0xFFFF: if the
+    # backend's maximum(x, 0) propagates it sign-intact, the shifted
+    # pattern lands in [0x8000, 0xFFFF] — pre-fix, the int32 clamp folded
+    # it to a FINITE ~1.7e38 that outranked every genuine activation and
+    # corrupted the row silently; the sign-aware guard must keep it a NaN
+    # (or, if the backend canonicalizes the sign away, an ordinary
+    # positive NaN) — either way the row behaves like the 0x7FFF case:
+    # clean rows bit-exact, the NaN row keeps >= k-1 of the finite top-k
+    # and NEVER contains a fabricated huge finite value.
+    h = jax.random.normal(jax.random.key(2), (8, 2**16), jnp.bfloat16)
+    neg_nan = jax.lax.bitcast_convert_type(jnp.uint16(0xFFFF), jnp.bfloat16)
+    assert bool(jnp.isnan(neg_nan))
+    h = h.at[0, 0].set(neg_nan)
+    out = np.asarray(tp.topk(h, 8, True)).astype(np.float32)
+    ref = np.asarray(act._topk_dense(h, 8)).astype(np.float32)
+    for r in range(1, 8):
+        assert np.array_equal(out[r], ref[r]), r
+    finite0 = out[0][np.isfinite(out[0])]
+    assert finite0.max(initial=0.0) < 1e30, "sign-set NaN leaked as finite"
+    kept = np.count_nonzero(out[0] != 0) + np.isnan(out[0]).sum()
+    assert kept >= 7, kept
+
 
 def test_supported_covers_wide_dicts():
     """supported() is True at every BASELINE dict size: bf16 2^15/2^16 via
