@@ -1,7 +1,8 @@
 """Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Five sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
-exactly ONE JSON line on stdout):
+Six sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
+exactly ONE machine-parseable JSON line on stdout, guaranteed last —
+stray prints are rerouted to stderr for the whole run):
 
 - **step**: the bare train step on device-resident batches (round-1's
   headline; BASELINE.json config 1 — dict 2^15, batch 4096, bf16).
@@ -18,6 +19,10 @@ exactly ONE JSON line on stdout):
   the full model — weights are random because this environment is
   air-gapped, which changes no matmul shapes). Reports steady-state
   acts/sec and the refresh-bubble profile (max vs median step).
+- **quant**: the int8 data-plane quality gates (docs/SCALING.md
+  "Quantized data plane"): roundtrip per-row MSE on a Gemma-shaped
+  heavy-tailed probe, store-byte ratio, and the quantized grad
+  all-reduce's one-shot + error-feedback accuracy on the local mesh.
 - **dash**: dashboard generation at the reference's recorded workload
   (128 seqs × 3 features, minibatch 4 — BASELINE.md: ≈19 s on A100).
 
@@ -32,7 +37,8 @@ over the layers below the hook (P = params in layers 0-13 of Gemma-2-2B
 per-chip parity — BASELINE.json.)
 
 Env knobs (debug/CI only): BENCH_SECTIONS, BENCH_DICT, BENCH_BATCH,
-BENCH_STEPS, BENCH_CPU=1, BENCH_MASTER_DTYPE.
+BENCH_STEPS, BENCH_CPU=1, BENCH_MASTER_DTYPE, BENCH_QUANT=1 (e2e with
+the int8 replay store), QUANT_RELMSE_BOUND.
 """
 
 from __future__ import annotations
@@ -109,23 +115,28 @@ def bench_step(cfg, n_steps: int, warmup: int = 3) -> dict:
     state = jax.device_put(state, shardings)
     # production mix: metric-only reductions (l0/EV) are gated to log_every
     # steps (1% at the reference cadence), so the bare step is the
-    # throughput-defining variant
-    step_fn = make_train_step(cfg, mesh, tx, shardings, with_metrics=False)
-    # AuxK amortization (cfg.aux_every > 1): alternate the aux-on and
-    # aux-off compiled variants exactly as the Trainer does, so the timed
-    # mix IS the production step cost
-    step_fn_off = None
-    if cfg.aux_k > 0 and cfg.aux_every > 1:
-        if warmup < 2:
-            raise ValueError("aux_every benching needs warmup >= 2 (both variants)")
-        step_fn_off = make_train_step(
-            cfg, mesh, tx, shardings, with_metrics=False, aux_on=False
-        )
+    # throughput-defining variant.
+    # AuxK amortization (cfg.aux_every > 1) and dead-mask caching
+    # (cfg.aux_mask_every != 1): alternate the compiled variants exactly as
+    # the Trainer does, so the timed mix IS the production step cost.
+    track_fired = cfg.aux_k > 0 or cfg.resample_every > 0
+    cached_mask = track_fired and cfg.aux_mask_every != 1
+    variants: dict = {}
+
+    def key_of(i: int) -> tuple[bool, bool]:
+        aux_on = cfg.aux_k == 0 or cfg.aux_every <= 1 or i % cfg.aux_every == 0
+        refresh = not cached_mask or i % cfg.aux_mask_cadence == 0
+        return (aux_on, refresh)
 
     def pick(i: int):
-        if step_fn_off is None or i % cfg.aux_every == 0:
-            return step_fn
-        return step_fn_off
+        key = key_of(i)
+        fn = variants.get(key)
+        if fn is None:
+            fn = variants[key] = make_train_step(
+                cfg, mesh, tx, shardings, with_metrics=False,
+                aux_on=key[0], mask_refresh=key[1],
+            )
+        return fn
 
     batch_sh = mesh_lib.batch_sharding(mesh)
     key = jax.random.key(0)
@@ -150,6 +161,13 @@ def bench_step(cfg, n_steps: int, warmup: int = 3) -> dict:
 
     for i in range(warmup):
         state, metrics = pick(i)(state, batches[i % 4], scale)
+    # any variant the timed window alternates onto must already be
+    # compiled, or its first hit would time a compile, not a step
+    warmed = {key_of(i) for i in range(warmup)}
+    for i in range(n_steps):
+        if key_of(i) not in warmed:
+            warmed.add(key_of(i))
+            state, metrics = pick(i)(state, batches[i % 4], scale)
     _sync(metrics["loss"])
 
     t0 = time.perf_counter()
@@ -248,13 +266,22 @@ def section_matrix() -> list[dict]:
         # over the masked [B,H] pre-acts, dense-matmul aux decode, fired
         # scatter) — the worst case. `topk_auxk` is the production
         # recommendation (aux_every=8 amortization; quality within noise
-        # of per-step, artifacts/ACT_QUALITY_r05.json); `_perstep` is the
-        # unamortized Gao-exact recipe for comparison (the r04 number).
+        # of per-step, artifacts/ACT_QUALITY_r05.json); `_perstep` keeps
+        # the aux loss on EVERY step (the Gao recipe, the BENCH_r05
+        # 391 ms number) but caches the dead mask at log_every cadence
+        # (aux_mask_every=0): reuse steps drop the tracker compare and the
+        # serial dependency on the previous step's fired scatter.
+        # `_perstep_exact` is the fully unamortized per-step-mask recipe
+        # for comparison.
         ("topk_auxk",
          dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
               aux_dead_steps=1, aux_every=8),
          "auto"),
         ("topk_auxk_perstep",
+         dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
+              aux_dead_steps=1, aux_mask_every=0),
+         "auto"),
+        ("topk_auxk_perstep_exact",
          dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
               aux_dead_steps=1),
          "auto"),
@@ -411,6 +438,11 @@ def section_e2e() -> dict:
     # at ~7 MB/s; on a local PCIe link the cost is negligible).
     buffer_device = os.environ.get("BENCH_BUFFER", "hbm")
     cfg = cfg.replace(buffer_device=buffer_device)
+    # BENCH_QUANT=1: the block-scaled int8 store (cfg.quant_buffer) — the
+    # acceptance A/B is this run vs the default at equal buffer_mult
+    if os.environ.get("BENCH_QUANT") == "1":
+        block = 256 if cfg.d_in % 256 == 0 else 16
+        cfg = cfg.replace(quant_buffer=True, quant_block=block)
     t0 = time.perf_counter()
     buffer = make_buffer(
         cfg, lm_cfg, params, tokens,
@@ -462,6 +494,8 @@ def section_e2e() -> dict:
         "n_steps_measured": n_steps,
         "loss_finite": bool(jnp.isfinite(loss)),
         "buffer_device": buffer_device,
+        "quant_buffer": cfg.quant_buffer,
+        "store_mbytes": round(buffer.store_nbytes() / 2**20, 1),
         "refill_frac": cfg.refill_frac,
         "workload": (
             f"{shape_tag} pair → blocks.{hook_layer} harvest → {buffer_device} "
@@ -470,6 +504,92 @@ def section_e2e() -> dict:
         ),
     }
     log(f"[e2e] {out}")
+    return out
+
+
+def section_quant() -> dict:
+    """The int8 data-plane quality gates (docs/SCALING.md "Quantized data
+    plane"), recorded in the bench JSON so every round carries them:
+
+    - roundtrip: per-row relative MSE of quantize→dequantize on a
+      Gemma-2-2B-shaped activation probe ([4096 rows, 2 sources, d_in
+      2304], heavy-tailed like calibrated residual streams), gated at
+      QUANT_RELMSE_BOUND (1e-3): ~2x above the probe's measured 4.7e-4
+      so outlier-distribution drift trips the gate, and still an order of
+      magnitude below any arm-to-arm delta the `_act_quality` probe
+      family resolves.
+    - store bytes: quantized/bf16 ratio at the production block size
+      (the HBM budget table's headline number).
+    - grad all-reduce: quantized-mean vs exact-mean relative error on an
+      8-virtual-device CPU mesh (compile+execute of the real
+      parallel/quant_ar exchange), plus the error-feedback check — the
+      RUNNING MEAN of compressed gradients converges to the exact mean.
+    """
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from crosscoder_tpu.ops import quant
+    from crosscoder_tpu.parallel import quant_ar
+
+    block, d_in, n_sources, rows = 256, 2304, 2, 4096
+    bound = float(os.environ.get("QUANT_RELMSE_BOUND", 1e-3))
+    rng = np.random.default_rng(11)
+    # heavy-tailed rows: gaussian bulk + sparse outlier features, the shape
+    # that breaks per-TENSOR scaling and the reason scales are per block
+    x = rng.normal(size=(rows, n_sources, d_in)).astype(np.float32)
+    outliers = rng.random((1, n_sources, d_in)) < 0.01
+    x = x * (1.0 + 9.0 * outliers)
+    q, s = jax.device_get(quant.quantize_blocks(jnp.asarray(x), block))
+    deq = quant.dequantize_np(np.asarray(q), np.asarray(s), np.float32)
+    err = np.sum((deq - x) ** 2, axis=(-2, -1))
+    power = np.sum(x ** 2, axis=(-2, -1))
+    rel_mse = float(np.mean(err / power))
+
+    store_ratio = quant.store_bytes((rows, n_sources, d_in), block) / (
+        2.0 * rows * n_sources * d_in
+    )
+
+    # quantized grad all-reduce vs the exact mean, on however many devices
+    # this process has (8 virtual in CI, 1 on a lone TPU chip → skipped)
+    n_dev = len(jax.devices())
+    ar = {}
+    if n_dev >= 2:
+        mesh = Mesh(np.asarray(jax.devices()), ("data",))
+        g = rng.normal(size=(n_dev, 8, d_in)).astype(np.float32)
+        ef0 = np.zeros((n_dev, quant_ar.padded_len(8 * d_in, n_dev, block)),
+                       np.float32)
+        fn = quant_ar.quantized_pmean_fn(mesh, block)
+        exact = g.mean(axis=0)
+        got, ef1 = fn(jnp.asarray(g), jnp.asarray(ef0))
+        got = np.asarray(jax.device_get(got))
+        one_shot = float(np.abs(got - exact).max() / np.abs(exact).max())
+        # error feedback: same gradient re-reduced with the carried
+        # residual — the running mean must converge on the exact mean
+        acc, ef_dev = np.zeros_like(exact), jnp.asarray(ef0)
+        steps = 8
+        for i in range(steps):
+            out, ef_dev = fn(jnp.asarray(g), ef_dev)
+            acc += np.asarray(jax.device_get(out))[0]
+        ef_mean = float(np.abs(acc / steps - exact).max() / np.abs(exact).max())
+        ar = {
+            "n_devices": n_dev,
+            "one_shot_rel_err": round(one_shot, 7),
+            "ef_running_mean_rel_err": round(ef_mean, 7),
+            "ef_improves": bool(ef_mean < one_shot),
+        }
+
+    out = {
+        "block": block,
+        "roundtrip_rel_mse": float(np.format_float_scientific(
+            rel_mse, precision=3, unique=False)),
+        "rel_mse_bound": bound,
+        "quality_gate_ok": bool(rel_mse < bound),
+        "store_bytes_ratio_vs_bf16": round(store_ratio, 4),
+        "grad_allreduce": ar,
+        "workload": f"[{rows}, {n_sources}, {d_in}] heavy-tailed probe, "
+                    f"block {block}",
+    }
+    log(f"[quant] {out}")
     return out
 
 
@@ -524,6 +644,21 @@ def section_dash() -> dict:
 
 
 def main() -> None:
+    # Output contract: stdout carries EXACTLY ONE machine-parseable JSON
+    # line, emitted last (the harness records "parsed": null otherwise).
+    # Library/trainer progress prints go through plain print() → reroute
+    # the whole module-level stdout to stderr for the run and write the
+    # headline to the real stream at the very end.
+    real_stdout = sys.stdout
+    sys.stdout = sys.stderr
+    try:
+        headline = _run_sections()
+    finally:
+        sys.stdout = real_stdout
+    print(json.dumps(headline), flush=True)
+
+
+def _run_sections() -> dict:
     if os.environ.get("BENCH_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
     # persistent compile cache: the bench's wall time is dominated by
@@ -538,12 +673,13 @@ def main() -> None:
     except OSError:
         cache_state = "cold"
     sections = os.environ.get(
-        "BENCH_SECTIONS", "step,matrix,configs,e2e,dash"
+        "BENCH_SECTIONS", "step,matrix,configs,e2e,quant,dash"
     ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
                      ("configs", section_configs),
-                     ("e2e", section_e2e), ("dash", section_dash)):
+                     ("e2e", section_e2e), ("quant", section_quant),
+                     ("dash", section_dash)):
         if name not in sections:
             continue
         try:
@@ -572,7 +708,7 @@ def main() -> None:
         }
     headline["compile_cache"] = cache_state
     headline.update(results)
-    print(json.dumps(headline))
+    return headline
 
 
 if __name__ == "__main__":

@@ -254,6 +254,43 @@ class CrossCoderConfig:
                                     # watchdog.py). 0 = off (default).
     harvest_retries: int = 3        # watchdog retry/extension budget
     harvest_backoff_s: float = 0.5  # base of the exponential retry backoff
+    # --- block-scaled int8 data plane (ops/quant.py; docs/SCALING.md
+    # "Quantized data plane"). Both off by default and ZERO-COST off: the
+    # compiled train step and the serve/refill paths are byte-identical to
+    # a build without these fields (asserted in tests/test_quant.py).
+    quant_buffer: bool = False      # replay store in block-scaled int8 +
+                                    # f32 scales instead of bf16: ~0.51x
+                                    # store bytes at quant_block=256, refill
+                                    # chunks quantized at harvest time so
+                                    # host↔device / ICI refill traffic
+                                    # halves; the serve path dequantizes in
+                                    # the same fused gather, so the trainer
+                                    # still receives bf16 rows
+    quant_grads: bool = False       # EQuARX-style quantized gradient
+                                    # all-reduce under pure data
+                                    # parallelism: per-device grads are
+                                    # block-scaled int8 through an
+                                    # all-to-all + all-gather pair (~2x
+                                    # less grad-sync wire traffic than the
+                                    # bf16 psum) with per-device error
+                                    # feedback carried in TrainState.aux
+                                    # ("quant_ef") so the compression bias
+                                    # cancels across steps
+    quant_block: int = 256          # elements per int8 scale block (the
+                                    # last-axis granularity). Must divide
+                                    # d_in when quant_buffer is on; store
+                                    # overhead is 4/quant_block bytes/elem
+    # AuxK dead-mask cadence: how often the trainer REFRESHES the dead-
+    # latent mask that gates the aux ranking/decode. 1 (default) =
+    # recompute every step (the exact Gao et al. recipe — required for
+    # engine-parity runs); N > 1 = refresh every N steps and reuse the
+    # cached mask between refreshes; 0 = refresh at cfg.log_every cadence.
+    # Fired-tracking (steps_since_fired) updates every step regardless, so
+    # a refresh always sees current deadness; between refreshes a revived
+    # latent keeps its aux gradient for at most one cadence window (the
+    # same staleness class as cfg.aux_every amortization, measured within
+    # noise — artifacts/ACT_QUALITY_r05.json).
+    aux_mask_every: int = 1
     chaos: str = ""                 # fault-injection spec (resilience/
                                     # chaos.py grammar; tests/staging
                                     # only). Empty = no chaos objects
@@ -290,10 +327,23 @@ class CrossCoderConfig:
                 f"shard_sources: n_sources {self.n_sources} must divide by "
                 f"model_axis_size {self.model_axis_size}"
             )
-        if not (0.0 < self.refill_frac <= 0.5):
+        # refill_frac is a FRACTION of the buffer: anything outside (0, 1]
+        # is meaningless, and anything above 0.5 would let a refill cycle
+        # overwrite rows the serve trigger (fixed at the reference's
+        # half-buffer point, buffer.py:121) has not yet served
+        if not (0.0 < self.refill_frac <= 1.0):
             raise ValueError(
-                f"refill_frac must be in (0, 0.5] (0.5 = reference parity; "
-                f"the serve trigger fires at half-buffer), got {self.refill_frac}"
+                f"refill_frac must be a buffer fraction in (0, 1], got "
+                f"{self.refill_frac}; 0.5 is reference parity (1:1 "
+                f"harvest:serve), smaller values re-serve survivors "
+                f"~0.5/refill_frac times"
+            )
+        if self.refill_frac > 0.5:
+            raise ValueError(
+                f"refill_frac must be <= 0.5 (the serve trigger fires at "
+                f"half-buffer, so a larger refill would overwrite unserved "
+                f"rows), got {self.refill_frac}; set 0.5 for reference "
+                f"parity"
             )
         if self.buffer_device not in ("host", "hbm"):
             raise ValueError(
@@ -392,12 +442,50 @@ class CrossCoderConfig:
                 f"harvest_retries/harvest_backoff_s must be >= 0, got "
                 f"{self.harvest_retries}/{self.harvest_backoff_s}"
             )
+        if self.quant_block < 1:
+            raise ValueError(
+                f"quant_block must be >= 1, got {self.quant_block}; 256 is "
+                f"the default (4/256 bytes/element of f32-scale overhead)"
+            )
+        if self.quant_buffer and self.d_in % self.quant_block != 0:
+            divisors = [b for b in (32, 64, 128, 256, 512)
+                        if self.d_in % b == 0]
+            raise ValueError(
+                f"quant_buffer: quant_block {self.quant_block} must divide "
+                f"d_in {self.d_in} (scales are per contiguous feature "
+                f"block); try one of {divisors or 'a divisor of d_in'}"
+            )
+        if self.quant_grads and (self.model_axis_size > 1 or self.shard_sources):
+            raise ValueError(
+                "quant_grads supports pure data parallelism only "
+                "(model_axis_size == 1, shard_sources off): the quantized "
+                "all-reduce replaces the DP gradient psum; TP/EP grad "
+                "slices keep the exact bf16/f32 psum"
+            )
+        if self.quant_grads and self.activation == "batchtopk":
+            raise ValueError(
+                "quant_grads is incompatible with activation='batchtopk': "
+                "the quantized step computes per-device losses, but "
+                "batchtopk's threshold is a GLOBAL-batch order statistic"
+            )
+        if self.aux_mask_every < 0:
+            raise ValueError(
+                f"aux_mask_every must be >= 0 (1 = per-step exact, N = "
+                f"refresh every N steps, 0 = follow log_every), got "
+                f"{self.aux_mask_every}"
+            )
 
     # --- derived quantities -------------------------------------------------
     @property
     def total_steps(self) -> int:
         """Optimizer steps for the token budget (reference trainer.py:14)."""
         return self.num_tokens // self.batch_size
+
+    @property
+    def aux_mask_cadence(self) -> int:
+        """Resolved dead-mask refresh cadence in steps (``aux_mask_every``,
+        with 0 meaning the ``log_every`` interval)."""
+        return self.aux_mask_every if self.aux_mask_every >= 1 else self.log_every
 
     @property
     def resample_threshold_steps(self) -> int:

@@ -200,6 +200,12 @@ class PairedActivationBuffer:
             (self.buffer_size, self.cfg.n_sources, self.cfg.d_in), dtype=_BF16
         )
 
+    def store_nbytes(self) -> int:
+        """Bytes the replay store occupies (host RAM here; HBM for the
+        device subclasses) — the accounting the quantized-plane HBM
+        budget asserts against."""
+        return self._store.nbytes
+
     def _refill_batches(self) -> int:
         """Sequences harvested per steady-state cycle. refill_frac 0.5 is
         the reference's half-refill (buffer.py:70-74); smaller fractions
@@ -640,14 +646,21 @@ def make_buffer(cfg: CrossCoderConfig, lm_cfg, model_params, tokens,
     """Construct the replay buffer per ``cfg.buffer_device`` (the single
     selection point — host RAM vs HBM store, same semantics). An HBM store
     on a multi-chip mesh shards over the ``data`` axis
-    (:class:`MeshPairedActivationBuffer`)."""
+    (:class:`MeshPairedActivationBuffer`). ``cfg.quant_buffer`` swaps in
+    the block-scaled int8 storage subclass of the same placement — the
+    bf16 classes are never touched when quantization is off (the zero-cost
+    guarantee tests/test_quant.py asserts)."""
     cls: type[PairedActivationBuffer] = PairedActivationBuffer
     if cfg.buffer_device == "hbm":
         bs = kwargs.get("batch_sharding")
         if bs is not None and int(bs.mesh.shape.get("data", 1)) > 1:
-            cls = MeshPairedActivationBuffer
+            cls = (QuantMeshPairedActivationBuffer if cfg.quant_buffer
+                   else MeshPairedActivationBuffer)
         else:
-            cls = DevicePairedActivationBuffer
+            cls = (QuantDevicePairedActivationBuffer if cfg.quant_buffer
+                   else DevicePairedActivationBuffer)
+    elif cfg.quant_buffer:
+        cls = QuantPairedActivationBuffer
     return cls(cfg, lm_cfg, model_params, tokens, **kwargs)
 
 
@@ -719,6 +732,9 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
     def _store(self) -> np.ndarray:
         """Host view (tests/analysis only — fetches the whole store)."""
         return np.asarray(jax.device_get(self._store_dev))
+
+    def store_nbytes(self) -> int:
+        return self._store_dev.nbytes
 
     # storage hooks the mesh-sharded subclass overrides -----------------
 
@@ -796,7 +812,7 @@ def _mesh_store_ops(mesh, rows_local: int, acts_sharded: bool):
     Contributions are disjoint across devices (each global row lives in
     exactly one shard), so the bf16 psum adds zeros — exact.
     """
-    from jax import shard_map
+    from crosscoder_tpu.parallel import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     acts_spec = P("data", None, None, None) if acts_sharded else P()
@@ -855,7 +871,11 @@ class MeshPairedActivationBuffer(DevicePairedActivationBuffer):
     shard count; pad rows are never referenced by the serve permutation.
     """
 
-    def _alloc_store(self) -> None:
+    def _mesh_setup(self):
+        """Shared geometry validation + row-shard accounting for the mesh
+        store (used by both the bf16 allocation below and the quantized
+        subclass's): returns ``(mesh, acts_sharded)`` and sets
+        ``_rows_local``/``_store_size``/``_acts_sharding``."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         cfg = self.cfg
@@ -882,6 +902,21 @@ class MeshPairedActivationBuffer(DevicePairedActivationBuffer):
             )
         self._rows_local = -(-self.buffer_size // n_shards)
         self._store_size = self._rows_local * n_shards
+        # under seq-parallel harvest the data axis carries the sequence, so
+        # chunks arrive without a batch sharding — use the replicated-acts
+        # scatter variant there
+        acts_sharded = self._seq_mesh is None
+        self._acts_sharding = NamedSharding(
+            mesh,
+            P("data", None, None, None) if acts_sharded else P(),
+        )
+        return mesh, acts_sharded
+
+    def _alloc_store(self) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg = self.cfg
+        mesh, acts_sharded = self._mesh_setup()
         sharding = NamedSharding(mesh, P("data", None, None))
         self._store_dev = jax.jit(
             functools.partial(
@@ -891,14 +926,6 @@ class MeshPairedActivationBuffer(DevicePairedActivationBuffer):
             ),
             out_shardings=sharding,
         )()
-        # under seq-parallel harvest the data axis carries the sequence, so
-        # chunks arrive without a batch sharding — use the replicated-acts
-        # scatter variant there
-        acts_sharded = self._seq_mesh is None
-        self._acts_sharding = NamedSharding(
-            mesh,
-            P("data", None, None, None) if acts_sharded else P(),
-        )
         self._scatter, self._gather = _mesh_store_ops(
             mesh, self._rows_local, acts_sharded
         )
@@ -922,3 +949,293 @@ class MeshPairedActivationBuffer(DevicePairedActivationBuffer):
         """Serve gather; the result comes back in the step's batch
         sharding (``P('data', None, None)``)."""
         return self._gather(self._store_dev, jnp.asarray(idx, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Block-scaled int8 storage variants (cfg.quant_buffer; ops/quant.py,
+# docs/SCALING.md "Quantized data plane").
+#
+# Same serve/refill/resume semantics as their bf16 parents — the cycle
+# accounting, permutation, and provenance bookkeeping are all inherited
+# untouched; only the ROW BYTES change representation:
+#
+# - chunks are quantized AT HARVEST TIME, on device, before any row leaves
+#   the chip: the host store's device→host chunk fetch, the device store's
+#   scatter writes, and the mesh store's all_gather refill shards all move
+#   int8 + f32 per-block scales (~0.51x the bf16 bytes at quant_block=256);
+# - the serve path dequantizes inside the same fused gather (one jit for
+#   the device stores, one numpy pass for the host store), so next_raw
+#   still hands the trainer bf16 rows and next() fp32 — the trainer cannot
+#   tell the stores apart;
+# - quantization is deterministic, so host and device quantized stores
+#   serve BIT-IDENTICAL rows from the same harvest chunks (asserted in
+#   tests/test_quant.py).
+#
+# These classes exist only behind cfg.quant_buffer in make_buffer: with the
+# flag off, none of their code (or int8 allocation) is reachable — the bf16
+# classes above are byte-for-byte the pre-quantization data plane.
+
+
+def _quant_module():
+    from crosscoder_tpu.ops import quant
+
+    return quant
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _quant_chunk(acts: jax.Array, block: int) -> tuple[jax.Array, jax.Array]:
+    """Quantize one padded harvest chunk ``[C, S, n, d]`` on device (the
+    host store's pre-fetch shrink: the chunk crosses PCIe at ~0.51x)."""
+    from crosscoder_tpu.ops import quant
+
+    return quant.quantize_rows(acts, block)
+
+
+@functools.partial(jax.jit, static_argnums=(4,), donate_argnums=(0, 1))
+def _dev_scatter_quant(
+    store_q: jax.Array, store_s: jax.Array, positions: jax.Array,
+    acts: jax.Array, block: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Quantize-then-scatter of one harvest chunk into the int8 store
+    (the donated in-place analogue of ``_dev_scatter``; same padded
+    unique-dropped-index contract)."""
+    from crosscoder_tpu.ops import quant
+
+    rows = acts[:, 1:].reshape(-1, acts.shape[2], acts.shape[3])
+    q, s = quant.quantize_rows(rows, block)
+    store_q = store_q.at[positions].set(q, mode="drop", unique_indices=True)
+    store_s = store_s.at[positions].set(s, mode="drop", unique_indices=True)
+    return store_q, store_s
+
+
+@jax.jit
+def _dev_gather_dequant(
+    store_q: jax.Array, store_s: jax.Array, idx: jax.Array
+) -> jax.Array:
+    """Fused gather + dequantize serve: int8 rows + scales gathered by
+    index, expanded to bf16 in the same compiled program (XLA fuses the
+    dequant into the gather's consumers — no int8 batch ever lands as a
+    separate HBM intermediate)."""
+    from crosscoder_tpu.ops import quant
+
+    return quant.dequantize_blocks(store_q[idx], store_s[idx], jnp.bfloat16)
+
+
+class QuantPairedActivationBuffer(PairedActivationBuffer):
+    """Host-RAM replay store in block-scaled int8 + f32 scales."""
+
+    def _alloc_store(self) -> None:
+        cfg = self.cfg
+        quant = _quant_module()
+        nb = quant.n_blocks(cfg.d_in, cfg.quant_block)
+        self._store_q = np.zeros(
+            (self.buffer_size, cfg.n_sources, cfg.d_in), np.int8
+        )
+        self._store_scale = np.zeros(
+            (self.buffer_size, cfg.n_sources, nb), np.float32
+        )
+
+    @property
+    def _store(self) -> np.ndarray:
+        """Dequantized bf16 view (tests/analysis only — materializes the
+        whole store)."""
+        return _quant_module().dequantize_np(
+            self._store_q, self._store_scale, _BF16
+        )
+
+    def store_nbytes(self) -> int:
+        return self._store_q.nbytes + self._store_scale.nbytes
+
+    def _drain_one(self) -> None:
+        cfg = self.cfg
+        rows_per_seq = cfg.seq_len - 1
+        acts_dev, n, seq_globals, woff = self._cyc_inflight.pop(0)
+        # quantize ON DEVICE, then fetch int8+scales: the chunk's
+        # device→host bytes drop ~2x before they touch the link
+        q_dev, s_dev = _quant_chunk(acts_dev, cfg.quant_block)
+        q = np.asarray(jax.device_get(q_dev))[:n, 1:]     # drop BOS
+        s = np.asarray(jax.device_get(s_dev))[:n, 1:]
+        rows_q = q.reshape(-1, cfg.n_sources, cfg.d_in)
+        rows_s = s.reshape(-1, cfg.n_sources, s.shape[-1])
+        positions = self._cyc_positions(woff, rows_q.shape[0])
+        self._store_q[positions] = rows_q
+        self._store_scale[positions] = rows_s
+        self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
+        self._cyc_drained += rows_q.shape[0]
+
+    def _gather_dequant(self, idx: np.ndarray, dtype) -> np.ndarray:
+        return _quant_module().dequantize_np(
+            self._store_q[idx], self._store_scale[idx], dtype
+        )
+
+    def next(self) -> np.ndarray:
+        idx = self._next_idx()
+        out = self._gather_dequant(idx, np.float32)
+        out *= self.normalisation_factor[None, :, None]
+        self._after_serve()
+        return out
+
+    def next_raw(self) -> np.ndarray:
+        idx = self._next_idx()
+        out = self._gather_dequant(idx, _BF16)
+        self._after_serve()
+        return out
+
+
+class QuantDevicePairedActivationBuffer(DevicePairedActivationBuffer):
+    """HBM replay store in block-scaled int8 + f32 scales (single-device).
+
+    Serve is the fused gather+dequant jit (``_dev_gather_dequant``);
+    refill quantizes inside the donated scatter. HBM for the store is
+    ``(1 + 4/quant_block)/2`` of the bf16 parent's — the budget headroom
+    that funds a ~2x buffer_mult (or dictionary) at equal HBM.
+    """
+
+    def _alloc_store(self) -> None:
+        cfg = self.cfg
+        quant = _quant_module()
+        nb = quant.n_blocks(cfg.d_in, cfg.quant_block)
+        self._store_q = jnp.zeros(
+            (self.buffer_size, cfg.n_sources, cfg.d_in), jnp.int8
+        )
+        self._store_scale = jnp.zeros(
+            (self.buffer_size, cfg.n_sources, nb), jnp.float32
+        )
+
+    @property
+    def _store(self) -> np.ndarray:
+        """Dequantized host view (tests/analysis only)."""
+        return _quant_module().dequantize_np(
+            np.asarray(jax.device_get(self._store_q)),
+            np.asarray(jax.device_get(self._store_scale)),
+            _BF16,
+        )
+
+    def store_nbytes(self) -> int:
+        return self._store_q.nbytes + self._store_scale.nbytes
+
+    def _scatter_chunk(self, positions: np.ndarray, acts_dev: jax.Array) -> None:
+        self._store_q, self._store_scale = _dev_scatter_quant(
+            self._store_q, self._store_scale,
+            jnp.asarray(positions, jnp.int32), acts_dev, self.cfg.quant_block,
+        )
+
+    def _gather_rows(self, idx: np.ndarray) -> jax.Array:
+        return _dev_gather_dequant(
+            self._store_q, self._store_scale, jnp.asarray(idx, jnp.int32)
+        )
+
+
+@functools.lru_cache(maxsize=8)
+def _mesh_store_ops_quant(mesh, rows_local: int, acts_sharded: bool, block: int):
+    """Quantized variants of :func:`_mesh_store_ops`, same sharded-store
+    contract with the row bytes in int8 + scales:
+
+    - *scatter*: rows quantize BEFORE the cross-device all_gather, so the
+      refill shards riding ICI are ~0.51x the bf16 bytes;
+    - *gather* (serve): the disjoint-contribution psum_scatter runs on the
+      int8 payload and the f32 scales separately (summing exact zeros is
+      exact in any dtype), then dequantizes LOCALLY on each device's batch
+      shard — serve ICI traffic halves and the output is the same bf16
+      batch in the step's ``P('data', None, None)`` sharding.
+    """
+    from crosscoder_tpu.ops import quant
+    from crosscoder_tpu.parallel import shard_map_compat as shard_map
+    from jax.sharding import PartitionSpec as P
+
+    acts_spec = P("data", None, None, None) if acts_sharded else P()
+
+    def scatter(store_q, store_s, positions, acts):
+        rows = acts[:, 1:].reshape(-1, acts.shape[2], acts.shape[3])
+        q, s = quant.quantize_rows(rows, block)
+        if acts_sharded:
+            q = jax.lax.all_gather(q, "data", axis=0, tiled=True)
+            s = jax.lax.all_gather(s, "data", axis=0, tiled=True)
+        my = jax.lax.axis_index("data")
+        local = positions - my * rows_local
+        oob = rows_local + jnp.arange(local.shape[0], dtype=local.dtype)
+        in_shard = (local >= 0) & (local < rows_local)
+        local = jnp.where(in_shard, local, oob)
+        store_q = store_q.at[local].set(q, mode="drop", unique_indices=True)
+        store_s = store_s.at[local].set(s, mode="drop", unique_indices=True)
+        return store_q, store_s
+
+    def gather(store_q, store_s, idx):
+        my = jax.lax.axis_index("data")
+        li = idx - my * rows_local
+        inb = (li >= 0) & (li < rows_local)
+        qrows = store_q[jnp.clip(li, 0, rows_local - 1)]
+        srows = store_s[jnp.clip(li, 0, rows_local - 1)]
+        qc = jnp.where(inb[:, None, None], qrows, jnp.zeros_like(qrows))
+        sc = jnp.where(inb[:, None, None], srows, jnp.zeros_like(srows))
+        qb = jax.lax.psum_scatter(qc, "data", scatter_dimension=0, tiled=True)
+        sb = jax.lax.psum_scatter(sc, "data", scatter_dimension=0, tiled=True)
+        return quant.dequantize_blocks(qb, sb, jnp.bfloat16)
+
+    store_spec = P("data", None, None)
+    scatter_jit = jax.jit(
+        shard_map(scatter, mesh=mesh,
+                  in_specs=(store_spec, store_spec, P(), acts_spec),
+                  out_specs=(store_spec, store_spec)),
+        donate_argnums=(0, 1),
+    )
+    gather_jit = jax.jit(
+        shard_map(gather, mesh=mesh,
+                  in_specs=(store_spec, store_spec, P()),
+                  out_specs=store_spec),
+    )
+    return scatter_jit, gather_jit
+
+
+class QuantMeshPairedActivationBuffer(MeshPairedActivationBuffer):
+    """Mesh-sharded HBM replay store in block-scaled int8 + f32 scales."""
+
+    def _alloc_store(self) -> None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg = self.cfg
+        quant = _quant_module()
+        nb = quant.n_blocks(cfg.d_in, cfg.quant_block)
+        mesh, acts_sharded = self._mesh_setup()
+        sharding = NamedSharding(mesh, P("data", None, None))
+        self._store_q = jax.jit(
+            functools.partial(
+                jnp.zeros, (self._store_size, cfg.n_sources, cfg.d_in),
+                jnp.int8,
+            ),
+            out_shardings=sharding,
+        )()
+        self._store_scale = jax.jit(
+            functools.partial(
+                jnp.zeros, (self._store_size, cfg.n_sources, nb),
+                jnp.float32,
+            ),
+            out_shardings=sharding,
+        )()
+        self._scatter, self._gather = _mesh_store_ops_quant(
+            mesh, self._rows_local, acts_sharded, cfg.quant_block
+        )
+
+    @property
+    def _store(self) -> np.ndarray:
+        """Dequantized host view (tests/analysis only)."""
+        return _quant_module().dequantize_np(
+            np.asarray(jax.device_get(self._store_q))[: self.buffer_size],
+            np.asarray(jax.device_get(self._store_scale))[: self.buffer_size],
+            _BF16,
+        )
+
+    def store_nbytes(self) -> int:
+        return self._store_q.nbytes + self._store_scale.nbytes
+
+    def _scatter_chunk(self, positions: np.ndarray, acts_dev: jax.Array) -> None:
+        acts_dev = jax.device_put(acts_dev, self._acts_sharding)
+        self._store_q, self._store_scale = self._scatter(
+            self._store_q, self._store_scale,
+            jnp.asarray(positions, jnp.int32), acts_dev,
+        )
+
+    def _gather_rows(self, idx: np.ndarray) -> jax.Array:
+        return self._gather(
+            self._store_q, self._store_scale, jnp.asarray(idx, jnp.int32)
+        )

@@ -947,7 +947,7 @@ def _seq_parallel_fn(
     """Compile-once builder for the sequence-parallel forward (keyed on
     everything that changes the traced program; token/batch shapes go
     through the inner jit's normal shape-keyed cache)."""
-    from jax import shard_map
+    from crosscoder_tpu.parallel import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis_name]
@@ -980,7 +980,7 @@ def _seq_parallel_multi_fn(
     per chunk. (Kept separate from ``_seq_parallel_fn``: the out-tree is a
     single stacked capture array, not the (logits, buffer) pair; the model
     count keys the inner jit's retrace via the params-tuple length.)"""
-    from jax import shard_map
+    from crosscoder_tpu.parallel import shard_map_compat as shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis_name]

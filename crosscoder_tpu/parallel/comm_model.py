@@ -139,6 +139,45 @@ class CommProfile:
         return sum(v for k, v in self.bytes_by_op.items() if k != "count")
 
 
+# Per-device WIRE bytes per OUTPUT byte for each collective under the
+# standard ring algorithms (jax-ml.github.io/scaling-book): an all-reduce
+# is a reduce-scatter + all-gather (2·(n−1)/n passes of the full tensor),
+# the one-phase collectives move (n−1)/n of their output, a
+# collective-permute moves exactly its payload. This is the factor that
+# makes QUANTIZED exchanges comparable to the implicit psum: a two-phase
+# int8 all-to-all + all-gather totals 2·(n−1)/n·N output bytes at 1 B/elem
+# where the bf16 all-reduce's single op line reads N output bytes at
+# 2 B/elem but costs 2·(n−1)/n passes on the wire.
+_WIRE_FACTORS = {
+    "all-reduce": 2.0,
+    "all-gather": 1.0,
+    "reduce-scatter": 1.0,
+    "all-to-all": 1.0,
+    "collective-permute": 1.0,
+}
+
+
+def wire_bytes(profile: CommProfile, axis_size: int | None = None) -> float:
+    """Modeled per-device ICI wire bytes per step: output bytes × the
+    ring-algorithm factor × (n−1)/n. ``axis_size`` is the participating
+    group width and defaults to the profile's DATA-axis size — right for
+    the DP gradient sync this model exists to compare; profiles whose
+    collectives run over a different axis (TP/mixed programs) must pass
+    their group width explicitly. A width of 1 means no ring at all:
+    zero wire bytes."""
+    n = axis_size if axis_size is not None else (
+        profile.n_devices // max(1, profile.model_axis)
+    )
+    if n <= 1:
+        return 0.0
+    ring = (n - 1) / n
+    return sum(
+        v * _WIRE_FACTORS[k] * ring
+        for k, v in profile.bytes_by_op.items()
+        if k in _WIRE_FACTORS
+    )
+
+
 def _compile_train_step(cfg, mesh):
     """Lower+compile the production train step (no execution)."""
     from crosscoder_tpu.parallel import mesh as mesh_lib
@@ -148,8 +187,10 @@ def _compile_train_step(cfg, mesh):
     import jax.numpy as jnp
 
     tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    n_data = int(mesh.shape.get("data", 1))
     state = jax.eval_shape(
-        lambda k: init_train_state(k, cfg, tx), jax.random.key(0)
+        lambda k: init_train_state(k, cfg, tx, n_data=n_data),
+        jax.random.key(0),
     )
     shardings = mesh_lib.state_shardings(mesh, state, cfg.shard_sources)
     step = make_train_step(cfg, mesh, tx, shardings, with_metrics=False)
@@ -231,6 +272,12 @@ def profile_width(n_devices: int, model_axis: int = 1,
     if "train" in programs:
         cfg = CrossCoderConfig(**base)
         prof("train_dp", 1, lambda mesh: _compile_train_step(cfg, mesh))
+    if "train_quant" in programs and n_devices > 1:
+        # the block-scaled int8 gradient all-reduce (cfg.quant_grads;
+        # parallel/quant_ar.py): same step, grad sync via int8
+        # all-to-all + all-gather instead of the bf16/f32 psum
+        qcfg = CrossCoderConfig(**base, quant_grads=True)
+        prof("train_dp_quant", 1, lambda mesh: _compile_train_step(qcfg, mesh))
     if "train_tp" in programs and model_axis > 1 and n_devices % model_axis == 0:
         cfg = CrossCoderConfig(
             **base, data_axis_size=n_devices // model_axis,

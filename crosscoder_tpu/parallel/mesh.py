@@ -39,6 +39,8 @@ _PARAM_SPECS: dict[str, P] = {
     "log_theta": P("model"),
     # AuxK dead-latent tracker (TrainState.aux): latent-axis, like b_enc
     "steps_since_fired": P("model"),
+    # cached dead mask (cfg.aux_mask_every): latent-axis, like the tracker
+    "dead_mask": P("model"),
 }
 
 # EP-style alternative (cfg.shard_sources, component N4 as a sharding mode):
@@ -56,6 +58,7 @@ _SOURCE_SPECS: dict[str, P] = {
     "b_dec": P("model", None),
     "log_theta": P(None),
     "steps_since_fired": P(None),
+    "dead_mask": P(None),
 }
 
 BATCH_SPEC = P("data", None, None)
@@ -127,8 +130,13 @@ def state_shardings(mesh: Mesh, state: Any, shard_sources: bool = False) -> Any:
     specs = _specs(shard_sources)
 
     def spec_of(path, leaf) -> NamedSharding:
-        for entry in reversed(path):
-            key = getattr(entry, "key", None)
+        keys = [getattr(entry, "key", None) for entry in path]
+        if "quant_ef" in keys:
+            # quantized-grad error-feedback residuals (parallel/quant_ar):
+            # [n_data, L] per param, each device owning exactly its own row
+            # — sharded over 'data' regardless of which param they shadow
+            return NamedSharding(mesh, P("data", None))
+        for key in reversed(keys):
             if key in specs:
                 if hasattr(leaf, "ndim") and leaf.ndim == len(specs[key]):
                     return NamedSharding(mesh, specs[key])

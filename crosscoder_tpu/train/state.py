@@ -41,7 +41,20 @@ def make_optimizer(cfg: CrossCoderConfig, lr_fn) -> optax.GradientTransformation
     )
 
 
-def init_train_state(key: jax.Array, cfg: CrossCoderConfig, tx: optax.GradientTransformation) -> TrainState:
+def resolve_data_axis(cfg: CrossCoderConfig) -> int:
+    """The mesh ``data``-axis width a cfg-built mesh would have — the
+    default for state pieces whose SHAPE depends on it (the quant_grads
+    error-feedback residuals). Callers holding an explicit mesh should
+    pass its axis size to :func:`init_train_state` instead."""
+    if cfg.data_axis_size > 0:
+        return cfg.data_axis_size
+    return max(1, jax.device_count() // max(1, cfg.model_axis_size))
+
+
+def init_train_state(
+    key: jax.Array, cfg: CrossCoderConfig, tx: optax.GradientTransformation,
+    n_data: int | None = None,
+) -> TrainState:
     # master weights in cfg.master_dtype — fp32 (default, a quality upgrade)
     # or bf16 (exact reference parity: its params and Adam moments are all
     # bf16, and ~2x less optimizer HBM traffic); the loss casts to
@@ -54,6 +67,20 @@ def init_train_state(key: jax.Array, cfg: CrossCoderConfig, tx: optax.GradientTr
         # has failed to fire for aux_dead_steps real steps (AuxK) /
         # resample_threshold_steps (resampling)
         aux = {"steps_since_fired": jnp.zeros((cfg.dict_size,), jnp.int32)}
+        if cfg.aux_mask_every != 1:
+            # cached dead mask (cfg.aux_mask_every): refreshed from
+            # steps_since_fired at the cadence, reused between refreshes;
+            # starts all-alive, exactly like the per-step mask at step 0
+            aux["dead_mask"] = jnp.zeros((cfg.dict_size,), jnp.bool_)
+    if cfg.quant_grads:
+        nd = resolve_data_axis(cfg) if n_data is None else n_data
+        if nd > 1:
+            from crosscoder_tpu.parallel import quant_ar
+
+            aux = dict(aux or {})
+            # per-device error-feedback residuals for the quantized
+            # gradient all-reduce (parallel/quant_ar.py), P('data')-sharded
+            aux["quant_ef"] = quant_ar.ef_init(params, nd, cfg.quant_block)
     return TrainState(
         params=params, opt_state=tx.init(params),
         step=jnp.zeros((), jnp.int32), aux=aux,

@@ -49,7 +49,7 @@ from crosscoder_tpu.utils.logging import MetricsLogger, ResilienceCounters, sour
 
 def make_train_step(
     cfg: CrossCoderConfig, mesh, tx, state_shardings, with_metrics: bool = True,
-    aux_on: bool = True,
+    aux_on: bool = True, mask_refresh: bool = True,
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
     """Build the compiled train step for a given mesh/optimizer.
 
@@ -60,6 +60,21 @@ def make_train_step(
     on device, fused by XLA into the encode (numerically identical to the
     reference's host-side ``acts.float() * factor``, reference
     ``buffer.py:123-124``, at half the host→device bytes).
+
+    ``mask_refresh`` only matters under cached dead masks
+    (``cfg.aux_mask_every != 1``): the refresh variant recomputes the
+    dead-latent mask from ``steps_since_fired`` and stores it in
+    ``aux["dead_mask"]``; the reuse variant reads the cached mask — the
+    Trainer alternates them at ``cfg.aux_mask_cadence``, exactly like the
+    ``aux_on`` pair.
+
+    ``cfg.quant_grads`` (pure DP only, validated in config) swaps the
+    implicit XLA gradient psum for the explicit block-scaled int8
+    all-reduce in :mod:`crosscoder_tpu.parallel.quant_ar`: per-device
+    gradients are computed inside a shard_map over the ``data`` axis and
+    exchanged quantized with error feedback; optimizer, clipping, and
+    schedules run outside on the (near-exact) mean gradient, so the step's
+    update math is otherwise identical.
     """
     if cfg.batchtopk_threshold > 0:
         # the frozen threshold is EVAL-only (calibrate_batchtopk_threshold):
@@ -75,6 +90,9 @@ def make_train_step(
     # only on aux_on steps (``cfg.aux_every`` amortization — the Trainer
     # compiles both variants and alternates)
     track_fired = cfg.aux_k > 0 or cfg.resample_every > 0
+    cached_mask = track_fired and cfg.aux_mask_every != 1
+    n_data = int(mesh.shape.get("data", 1))
+    use_qgrads = cfg.quant_grads and n_data > 1
     loss_fn = functools.partial(
         cc.training_loss, cfg=cfg, with_metrics=with_metrics,
         track_fired=track_fired,
@@ -83,6 +101,57 @@ def make_train_step(
         loss_fn = jax.checkpoint(loss_fn)
 
     warm_fn = schedules.sparsity_warmup_schedule(cfg)
+
+    def _dead_mask(state: TrainState):
+        """The dead-latent mask this step trains against: recomputed from
+        the tracker (per-step mode, or a cached-mode refresh step) or read
+        from the cache (cfg.aux_mask_every reuse steps — saves the compare
+        AND breaks the serial dependency on the previous step's fired
+        scatter)."""
+        if not track_fired:
+            return None
+        if cached_mask and not mask_refresh:
+            return state.aux["dead_mask"]
+        thresh = (cfg.aux_dead_steps if cfg.aux_k > 0
+                  else cfg.resample_threshold_steps)
+        return state.aux["steps_since_fired"] >= thresh
+
+    def _finish(state, grads, l1_coeff, dead, new_ef, loss, mets):
+        """Shared tail: optimizer update, aux bookkeeping, metric dict.
+        ``mets`` carries the loss surface pieces (already globally reduced
+        on the quantized path)."""
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "l2_loss": mets["l2_loss"],
+            "l1_loss": mets["l1_loss"],
+            "l1_coeff": l1_coeff,
+            "lr": lr_fn(state.step),
+        }
+        new_aux = state.aux
+        if track_fired or new_ef is not None:
+            new_aux = dict(state.aux)
+        if track_fired:
+            new_aux["steps_since_fired"] = jnp.where(
+                mets["fired"], 0, state.aux["steps_since_fired"] + 1
+            )
+            if cached_mask:
+                new_aux["dead_mask"] = dead
+            metrics["dead_frac"] = jnp.mean(dead.astype(jnp.float32))
+            if "aux_loss" in mets:
+                metrics["aux_loss"] = mets["aux_loss"]
+        if new_ef is not None:
+            new_aux["quant_ef"] = new_ef
+        if with_metrics:
+            metrics["l0_loss"] = mets["l0_loss"]
+            metrics["explained_variance"] = mets["explained_variance"]
+            # [n_sources]
+            metrics["explained_variance_per_source"] = mets[
+                "explained_variance_per_source"
+            ]
+        new_state = TrainState(new_params, new_opt, state.step + 1, new_aux)
+        return new_state, metrics
 
     def step_fn(state: TrainState, batch: jax.Array, scale: jax.Array):
         x = batch.astype(jnp.float32) * scale[None, :, None]
@@ -93,58 +162,110 @@ def make_train_step(
             # L0 warms up over the same window as L1 (reference
             # trainer.py:34-39's ramp, applied to both sparsity terms)
             kwargs["l0_coeff"] = cfg.l0_coeff * warm_fn(state.step)
-        dead = None
-        if track_fired:
-            # AuxK (dead-latent revival): latents quiet for aux_dead_steps
-            # are "dead"; the aux loss reconstructs the step's residual
-            # with the top aux_k of them. Same warmup ramp as the other
-            # sparsity terms (and naturally inert for the first
-            # aux_dead_steps — nothing can be dead yet). ``aux_on=False``
-            # (the off-steps of cfg.aux_every amortization) keeps the
-            # deadness metric and fired-tracking but compiles the aux
-            # ranking+decode out entirely. Resampling-only configs
-            # (aux_k == 0, resample_every > 0) track deadness at their
-            # own threshold for the metric + the resample fn.
-            thresh = (cfg.aux_dead_steps if cfg.aux_k > 0
-                      else cfg.resample_threshold_steps)
-            dead = state.aux["steps_since_fired"] >= thresh
-            if cfg.aux_k > 0 and aux_on:
-                kwargs["dead_mask"] = dead
-                kwargs["aux_coeff"] = cfg.aux_k_coeff * warm_fn(state.step)
+        # AuxK (dead-latent revival): latents quiet for aux_dead_steps
+        # are "dead"; the aux loss reconstructs the step's residual
+        # with the top aux_k of them. Same warmup ramp as the other
+        # sparsity terms (and naturally inert for the first
+        # aux_dead_steps — nothing can be dead yet). ``aux_on=False``
+        # (the off-steps of cfg.aux_every amortization) keeps the
+        # deadness metric and fired-tracking but compiles the aux
+        # ranking+decode out entirely. Resampling-only configs
+        # (aux_k == 0, resample_every > 0) track deadness at their
+        # own threshold for the metric + the resample fn.
+        dead = _dead_mask(state)
+        if dead is not None and cfg.aux_k > 0 and aux_on:
+            kwargs["dead_mask"] = dead
+            kwargs["aux_coeff"] = cfg.aux_k_coeff * warm_fn(state.step)
         (loss, losses), grads = grad_fn(state.params, x, l1_coeff, **kwargs)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        metrics = {
-            "loss": loss,
+        mets = {
             "l2_loss": losses.l2_loss,
             "l1_loss": losses.l1_loss,
-            "l1_coeff": l1_coeff,
-            "lr": lr_fn(state.step),
+            "fired": losses.fired,
         }
-        new_aux = state.aux
-        if track_fired:
-            new_aux = {
-                "steps_since_fired": jnp.where(
-                    losses.fired, 0, state.aux["steps_since_fired"] + 1
-                )
-            }
-            metrics["dead_frac"] = jnp.mean(dead.astype(jnp.float32))
-            if cfg.aux_k > 0 and aux_on:
-                metrics["aux_loss"] = losses.aux_loss
+        if dead is not None and cfg.aux_k > 0 and aux_on:
+            mets["aux_loss"] = losses.aux_loss
         if with_metrics:
-            metrics["l0_loss"] = losses.l0_loss
-            metrics["explained_variance"] = jnp.mean(losses.explained_variance)
-            # [n_sources]
-            metrics["explained_variance_per_source"] = jnp.mean(
+            mets["l0_loss"] = losses.l0_loss
+            mets["explained_variance"] = jnp.mean(losses.explained_variance)
+            mets["explained_variance_per_source"] = jnp.mean(
                 losses.explained_variance_per_source, axis=-1
             )
-        new_state = TrainState(new_params, new_opt, state.step + 1, new_aux)
-        return new_state, metrics
+        return _finish(state, grads, l1_coeff, dead, None, loss, mets)
+
+    def quant_step_fn(state: TrainState, batch: jax.Array, scale: jax.Array):
+        from jax.sharding import PartitionSpec as P
+
+        from crosscoder_tpu.parallel import quant_ar, shard_map_compat
+
+        l1_coeff = l1_fn(state.step)
+        dead = _dead_mask(state)
+        have_l0 = cfg.l0_coeff > 0
+        have_aux = dead is not None and cfg.aux_k > 0 and aux_on
+        # positional extras keep the shard_map spec list aligned with the
+        # actually-engaged loss knobs (all replicated scalars/masks)
+        args = [state.params, batch, scale, state.aux["quant_ef"], l1_coeff]
+        specs = [P(), mesh_lib.BATCH_SPEC, P(), P("data"), P()]
+        if have_l0:
+            args.append(cfg.l0_coeff * warm_fn(state.step))
+            specs.append(P())
+        if have_aux:
+            args.append(dead)
+            specs.append(P())
+            args.append(cfg.aux_k_coeff * warm_fn(state.step))
+            specs.append(P())
+
+        def local_fn(params, xb, sc, ef, l1c, *extras):
+            """Per-device: loss+grads on the local batch shard, then the
+            quantized mean all-reduce; every returned metric is globally
+            reduced (pmean of equal-sized shard means = the global mean
+            the unquantized step computes)."""
+            i = 0
+            kw: dict[str, Any] = {}
+            if have_l0:
+                kw["l0_coeff"] = extras[i]
+                i += 1
+            if have_aux:
+                kw["dead_mask"] = extras[i]
+                kw["aux_coeff"] = extras[i + 1]
+                i += 2
+            x = xb.astype(jnp.float32) * sc[None, :, None]
+            (loss, losses), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, x, l1c, **kw
+            )
+            g, new_ef = quant_ar.quantized_pmean_tree(
+                g, ef, "data", n_data, cfg.quant_block
+            )
+            pm = functools.partial(jax.lax.pmean, axis_name="data")
+            mets = {"l2_loss": pm(losses.l2_loss),
+                    "l1_loss": pm(losses.l1_loss)}
+            if track_fired:
+                mets["fired"] = jax.lax.psum(
+                    losses.fired.astype(jnp.int32), "data"
+                ) > 0
+            if have_aux:
+                mets["aux_loss"] = pm(losses.aux_loss)
+            if with_metrics:
+                mets["l0_loss"] = pm(losses.l0_loss)
+                mets["explained_variance"] = pm(
+                    jnp.mean(losses.explained_variance)
+                )
+                mets["explained_variance_per_source"] = pm(
+                    jnp.mean(losses.explained_variance_per_source, axis=-1)
+                )
+            return g, new_ef, pm(loss), mets
+
+        grads, new_ef, loss, mets = shard_map_compat(
+            local_fn, mesh=mesh, in_specs=tuple(specs),
+            out_specs=(P(), P("data"), P(), P()), check_vma=False,
+        )(*args)
+        if not track_fired:
+            mets["fired"] = None
+        return _finish(state, grads, l1_coeff, dead, new_ef, loss, mets)
 
     batch_sh = mesh_lib.batch_sharding(mesh)
     replicated = NamedSharding(mesh, PartitionSpec())
     return jax.jit(
-        step_fn,
+        quant_step_fn if use_qgrads else step_fn,
         in_shardings=(state_shardings, batch_sh, replicated),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
@@ -230,15 +351,21 @@ class Trainer:
                 )
 
         self._tx = tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
-        state = init_train_state(jax.random.key(cfg.seed), cfg, tx)
+        # n_data pins the quant_grads error-feedback residual shapes to
+        # THIS mesh (checkpoints of quant runs restore on a same-width mesh)
+        state = init_train_state(
+            jax.random.key(cfg.seed), cfg, tx,
+            n_data=int(self.mesh.shape.get("data", 1)),
+        )
         self._state_shardings = mesh_lib.state_shardings(self.mesh, state, cfg.shard_sources)
         self.state = jax.device_put(state, self._state_shardings)
-        # compiled step variants, keyed (with_metrics, aux_on); built lazily
-        # except the default. aux_on alternates per cfg.aux_every (AuxK
-        # amortization); the host-side step mirror picks the variant without
-        # a device sync.
-        self._step_fns: dict[tuple[bool, bool], Callable] = {
-            (True, True): make_train_step(cfg, self.mesh, tx, self._state_shardings)
+        # compiled step variants, keyed (with_metrics, aux_on, mask_refresh);
+        # built lazily except the default. aux_on alternates per
+        # cfg.aux_every (AuxK amortization), mask_refresh per
+        # cfg.aux_mask_cadence (cached dead masks); the host-side step
+        # mirror picks the variant without a device sync.
+        self._step_fns: dict[tuple[bool, bool, bool], Callable] = {
+            (True, True, True): make_train_step(cfg, self.mesh, tx, self._state_shardings)
         }
         self._host_step = 0
         self._batch_sharding = mesh_lib.batch_sharding(self.mesh)
@@ -424,12 +551,20 @@ class Trainer:
         # aux_on=True is the canonical variant when AuxK is off or per-step
         aux_on = (cfg.aux_k == 0 or cfg.aux_every <= 1
                   or self._host_step % cfg.aux_every == 0)
-        key = (full_metrics, aux_on)
+        # mask_refresh=True is canonical when masks are per-step
+        # (aux_mask_every == 1, the default) or no mask exists at all;
+        # cached-mask runs refresh at the cadence and reuse in between
+        cached_mask = ((cfg.aux_k > 0 or cfg.resample_every > 0)
+                       and cfg.aux_mask_every != 1)
+        mask_refresh = (not cached_mask
+                        or self._host_step % cfg.aux_mask_cadence == 0)
+        key = (full_metrics, aux_on, mask_refresh)
         fn = self._step_fns.get(key)
         if fn is None:
             fn = self._step_fns[key] = make_train_step(
                 cfg, self.mesh, self._tx, self._state_shardings,
                 with_metrics=full_metrics, aux_on=aux_on,
+                mask_refresh=mask_refresh,
             )
         batch, scale = self._next_batch()
         n_resampled = None

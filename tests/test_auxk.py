@@ -268,8 +268,9 @@ def test_aux_every_amortization_semantics():
     # aux steps at host steps 0, 3, 6
     assert seen_keys == [True, False, False, True, False, False, True]
     assert tr._host_step == 7
-    # both compiled variants exist
-    assert (True, True) in tr._step_fns and (True, False) in tr._step_fns
+    # both compiled variants exist (keys: with_metrics, aux_on, mask_refresh)
+    assert (True, True, True) in tr._step_fns
+    assert (True, False, True) in tr._step_fns
     tr.close()
 
 
@@ -296,3 +297,57 @@ def test_config_rejects_bad_aux_every():
         _cfg(aux_every=0)
     with pytest.raises(ValueError):
         _cfg(aux_every=-3)
+
+
+def test_aux_mask_cache_refresh_and_reuse_semantics():
+    """cfg.aux_mask_every > 1: the dead mask refreshes only at the cadence
+    and is REUSED in between — with aux_dead_steps=1, latents dying at
+    step 1 cannot draw aux gradient until the step-3 refresh, so aux_loss
+    is exactly 0 on the stale-mask steps and engages at the refresh."""
+    from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+
+    cfg = _cfg(activation="topk", topk_k=4, aux_k=8, aux_dead_steps=1,
+               aux_mask_every=3, prefetch=False)
+    tr = Trainer(cfg, SyntheticActivationSource(cfg))
+    assert "dead_mask" in tr.state.aux
+    aux_losses, dead_fracs = [], []
+    for _ in range(7):
+        m = tr.step()
+        aux_losses.append(float(jax.device_get(m["aux_loss"])))
+        dead_fracs.append(float(jax.device_get(m["dead_frac"])))
+    tr.close()
+    # steps 0-2 use the step-0 mask (nothing dead yet: tracker starts 0);
+    # the step-3 refresh sees the step-1+ deaths and engages the aux loss
+    assert aux_losses[0] == 0 and aux_losses[1] == 0 and aux_losses[2] == 0
+    assert dead_fracs[0] == 0 and dead_fracs[2] == 0
+    assert any(a > 0 for a in aux_losses[3:]), aux_losses
+    assert dead_fracs[3] > 0
+    # refresh/reuse variants both compiled
+    assert (True, True, True) in tr._step_fns
+    assert (True, True, False) in tr._step_fns
+
+
+def test_aux_mask_cache_matches_perstep_when_masks_agree():
+    """With a horizon no latent ever crosses, the cached mask equals the
+    per-step mask on every step, so the trajectories must be identical
+    (the caching changes WHICH mask is used, never the step math)."""
+    from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+
+    outs = []
+    for mask_every in (1, 4):
+        cfg = _cfg(activation="topk", topk_k=4, aux_k=8,
+                   aux_dead_steps=10_000, aux_mask_every=mask_every,
+                   prefetch=False)
+        tr = Trainer(cfg, SyntheticActivationSource(cfg))
+        for _ in range(6):
+            m = tr.step()
+        outs.append(np.asarray(jax.device_get(m["loss"]), np.float64))
+        tr.close()
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+
+
+def test_config_rejects_bad_aux_mask_every():
+    with pytest.raises(ValueError):
+        _cfg(aux_mask_every=-1)
+    assert _cfg(aux_mask_every=0, log_every=50).aux_mask_cadence == 50
+    assert _cfg(aux_mask_every=7).aux_mask_cadence == 7

@@ -1,0 +1,455 @@
+"""Block-scaled int8 data plane (cfg.quant_buffer / cfg.quant_grads;
+ops/quant.py, parallel/quant_ar.py, docs/SCALING.md "Quantized data
+plane"): numeric oracles, buffer-storage parity across all three store
+placements, the HBM budget assertion, the quantized gradient all-reduce's
+trajectory + modeled-bytes acceptance, and the zero-cost-off guarantees
+(step-HLO identity, no extra transfers). All CPU, tier-1."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data import buffer as buffer_mod
+from crosscoder_tpu.data.buffer import make_buffer
+from crosscoder_tpu.models import lm
+from crosscoder_tpu.ops import quant
+from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.parallel import quant_ar
+
+SEQ = 17
+HP = "blocks.2.hook_resid_pre"
+
+
+@pytest.fixture(scope="module")
+def lm_pair():
+    cfg = lm.LMConfig.tiny()
+    pa = lm.init_params(jax.random.key(0), cfg)
+    pb = lm.init_params(jax.random.key(1), cfg)
+    return cfg, [pa, pb]
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(7)
+    return rng.integers(0, 257, size=(256, SEQ), dtype=np.int64)
+
+
+def make_cfg(**kw):
+    base = dict(
+        batch_size=32, buffer_mult=32, seq_len=SEQ, d_in=32, n_models=2,
+        model_batch_size=4, norm_calib_batches=2, hook_point=HP, seed=3,
+        quant_block=16,
+    )
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# quantize/dequantize numerics
+
+
+def test_quantize_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(33, 3, 128)).astype(np.float32) * 7.0
+    x[0, 0, :64] = 0.0                                 # an all-zero block
+    q_np, s_np = quant.quantize_np(x, 64)
+    q_j, s_j = jax.device_get(quant.quantize_blocks(jnp.asarray(x), 64))
+    np.testing.assert_array_equal(np.asarray(q_j), q_np)
+    np.testing.assert_allclose(np.asarray(s_j), s_np, rtol=1e-7)
+    # zero blocks roundtrip to exact zeros
+    deq = quant.dequantize_np(q_np, s_np, np.float32)
+    assert (deq[0, 0, :64] == 0).all()
+    # jnp and numpy dequant agree
+    deq_j = jax.device_get(quant.dequantize_blocks(
+        jnp.asarray(q_np), jnp.asarray(s_np), jnp.float32))
+    np.testing.assert_allclose(np.asarray(deq_j), deq, rtol=1e-6)
+
+
+def test_roundtrip_error_bounded():
+    """Symmetric per-block int8: elementwise error <= scale/2, i.e. each
+    value is within (block max)/254 of its original."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(64, 2, 256)).astype(np.float32)
+    q, s = quant.quantize_np(x, 32)
+    deq = quant.dequantize_np(q, s, np.float32)
+    bound = np.repeat(s, 32, axis=-1) / 2 + 1e-7
+    assert (np.abs(deq - x) <= bound).all()
+    rel_mse = np.sum((deq - x) ** 2) / np.sum(x ** 2)
+    assert rel_mse < 4e-4                              # the bench gate bound
+
+
+def test_pallas_interpret_matches_xla():
+    """The fused Pallas rowwise quantize kernel (interpret mode on CPU)
+    must agree with the XLA lowering bit-for-bit."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(64, 512)).astype(np.float32))
+    assert quant.rows_supported(64, 512, 128)
+    q_ref, s_ref = jax.device_get(quant.quantize_blocks(x, 128))
+    quant.set_interpret(True)
+    try:
+        q_k, s_k = jax.device_get(quant.quantize_rows(x, 128))
+    finally:
+        quant.set_interpret(False)
+    np.testing.assert_array_equal(np.asarray(q_k), np.asarray(q_ref))
+    np.testing.assert_allclose(np.asarray(s_k), np.asarray(s_ref), rtol=1e-7)
+
+
+def test_rows_supported_gates():
+    assert not quant.rows_supported(64, 512, 100)      # block not lane-aligned
+    assert not quant.rows_supported(63, 512, 128)      # rows not 32-aligned
+    assert not quant.rows_supported(64, 500, 128)      # width % block
+    # grid floors at rows_blk=256: a 320-row input would leave rows
+    # 256-319 unwritten — the gate must reject it (kernel falls back)
+    assert not quant.rows_supported(320, 512, 128)
+    assert quant.rows_supported(512, 512, 128)
+
+
+def test_quantize_rows_partial_tail_falls_back_correct():
+    """Regression: n_rows > 256 and not a multiple of 256 must NOT go
+    through the Pallas kernel (whose grid floors and never writes the
+    tail tile) — quantize_rows falls back to XLA and stays exact."""
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.normal(size=(320, 512)).astype(np.float32))
+    q_ref, s_ref = jax.device_get(quant.quantize_blocks(x, 128))
+    quant.set_interpret(True)
+    try:
+        q_k, s_k = jax.device_get(quant.quantize_rows(x, 128))
+    finally:
+        quant.set_interpret(False)
+    np.testing.assert_array_equal(np.asarray(q_k), np.asarray(q_ref))
+    np.testing.assert_array_equal(np.asarray(s_k), np.asarray(s_ref))
+
+
+# ---------------------------------------------------------------------------
+# config validation (satellite)
+
+
+def test_config_rejects_bad_quant_block():
+    with pytest.raises(ValueError, match="must be >= 1"):
+        make_cfg(quant_block=0)
+    with pytest.raises(ValueError, match="must divide"):
+        make_cfg(quant_buffer=True, quant_block=7)
+    # off: any positive block is allowed (gradient blocks pad internally)
+    make_cfg(quant_block=7)
+
+
+def test_config_rejects_bad_refill_frac():
+    with pytest.raises(ValueError, match=r"\(0, 1\]"):
+        make_cfg(refill_frac=0.0)
+    with pytest.raises(ValueError, match=r"\(0, 1\]"):
+        make_cfg(refill_frac=-0.25)
+    with pytest.raises(ValueError, match=r"\(0, 1\]"):
+        make_cfg(refill_frac=1.5)
+    with pytest.raises(ValueError, match="0.5"):
+        make_cfg(refill_frac=0.75)                     # in (0,1] but unsafe
+    make_cfg(refill_frac=0.5)
+    make_cfg(refill_frac=0.25)
+
+
+def test_config_rejects_quant_grads_beyond_pure_dp():
+    with pytest.raises(ValueError, match="pure data parallelism"):
+        make_cfg(quant_grads=True, model_axis_size=2)
+    with pytest.raises(ValueError, match="batchtopk"):
+        make_cfg(quant_grads=True, activation="batchtopk")
+    make_cfg(quant_grads=True)
+
+
+# ---------------------------------------------------------------------------
+# quantized replay stores: parity across placements + the HBM budget
+
+
+def test_host_quant_buffer_tracks_bf16_store(lm_pair, tokens):
+    lm_cfg, params = lm_pair
+    b_bf = make_buffer(make_cfg(), lm_cfg, params, tokens)
+    b_q = make_buffer(make_cfg(quant_buffer=True), lm_cfg, params, tokens)
+    assert type(b_q) is buffer_mod.QuantPairedActivationBuffer
+    for _ in range(8):
+        r_bf = np.asarray(b_bf.next_raw(), np.float32)
+        r_q = np.asarray(b_q.next_raw(), np.float32)
+        assert r_q.shape == r_bf.shape and r_q.dtype == r_bf.dtype
+        # same serve stream (same seed → same perm/pointer), values within
+        # the per-block quantization bound
+        denom = np.abs(r_bf).max()
+        assert np.abs(r_q - r_bf).max() / denom < 0.01
+    # next() applies the same norm factors
+    n_bf = b_bf.next()
+    n_q = b_q.next()
+    assert np.abs(n_q - n_bf).max() / np.abs(n_bf).max() < 0.01
+
+
+def test_device_and_mesh_quant_stores_serve_bitidentical(lm_pair, tokens):
+    """Quantization is deterministic, so all three placements must serve
+    the SAME bytes from the same harvest chunks — not merely close."""
+    lm_cfg, params = lm_pair
+    b_host = make_buffer(make_cfg(quant_buffer=True), lm_cfg, params, tokens)
+    b_dev = make_buffer(
+        make_cfg(quant_buffer=True, buffer_device="hbm"), lm_cfg, params, tokens
+    )
+    mesh = mesh_lib.make_mesh(4, 1, devices=jax.devices()[:4])
+    b_mesh = make_buffer(
+        make_cfg(quant_buffer=True, buffer_device="hbm"), lm_cfg, params,
+        tokens, batch_sharding=NamedSharding(mesh, P("data", None)),
+    )
+    assert type(b_dev) is buffer_mod.QuantDevicePairedActivationBuffer
+    assert type(b_mesh) is buffer_mod.QuantMeshPairedActivationBuffer
+    # enough serves to cross a refill cycle (trigger at buffer//2 - batch)
+    for _ in range(18):
+        r_h = np.asarray(b_host.next_raw())
+        r_d = np.asarray(jax.device_get(b_dev.next_raw()))
+        r_m = np.asarray(jax.device_get(b_mesh.next_raw()))
+        np.testing.assert_array_equal(r_d, r_h)
+        np.testing.assert_array_equal(r_m, r_h)
+
+
+def test_quant_store_hbm_budget(lm_pair, tokens):
+    """Acceptance: device-store HBM bytes <= 0.55x the bf16 baseline at
+    the production geometry (d_in 2304, block 256 → (1 + 4/256)/2 ≈
+    0.508). Allocated lazily (no fill) so the real Gemma-width store is
+    built and measured without a Gemma-width harvest."""
+    lm_cfg, params = lm_pair
+    kw = dict(d_in=2304, quant_block=256, buffer_device="hbm")
+    b_bf = make_buffer(make_cfg(**kw), lm_cfg, params, tokens, lazy=True)
+    b_q = make_buffer(make_cfg(quant_buffer=True, **kw), lm_cfg, params,
+                      tokens, lazy=True)
+    ratio = b_q.store_nbytes() / b_bf.store_nbytes()
+    assert ratio <= 0.55, ratio
+    # the analytic accounting agrees
+    analytic = quant.store_bytes((4096, 2, 2304), 256) / (2 * 4096 * 2 * 2304)
+    assert abs(ratio - analytic) < 1e-6
+
+
+def test_quant_buffer_resume_roundtrip(lm_pair, tokens):
+    """state_dict/load_state_dict semantics are inherited: a restored
+    quantized buffer re-fills from the checkpoint stream position and
+    serves the same rows as a restored bf16 buffer (within quantization)."""
+    lm_cfg, params = lm_pair
+    b_q = make_buffer(make_cfg(quant_buffer=True), lm_cfg, params, tokens)
+    for _ in range(5):
+        b_q.next_raw()
+    snap = b_q.state_dict()
+    b_q2 = make_buffer(make_cfg(quant_buffer=True), lm_cfg, params, tokens,
+                       lazy=True)
+    b_q2.load_state_dict(snap)
+    expect = np.asarray(b_q2._store[b_q2._perm[:32]]).copy()
+    np.testing.assert_array_equal(np.asarray(b_q2.next_raw()), expect)
+
+
+# ---------------------------------------------------------------------------
+# quantized gradient all-reduce
+
+
+def _dp_mesh(n=4):
+    return mesh_lib.make_mesh(n, 1, devices=jax.devices()[:n])
+
+
+def test_quantized_pmean_matches_exact_mean():
+    """One exchange of the real quant_ar collective vs the exact mean on a
+    4-device mesh; error bounded by two rounds of per-block quantization."""
+    n_dev, block = 4, 32
+    rng = np.random.default_rng(5)
+    g = rng.normal(size=(n_dev, 7, 33)).astype(np.float32)   # odd sizes pad
+    L = quant_ar.padded_len(7 * 33, n_dev, block)
+    ef0 = np.zeros((n_dev, L), np.float32)
+    mesh = _dp_mesh(n_dev)
+    fn = quant_ar.quantized_pmean_fn(mesh, block)
+    out, ef1 = fn(jnp.asarray(g), jnp.asarray(ef0))
+    out = np.asarray(jax.device_get(out))
+    exact = g.mean(axis=0)
+    # every device holds the same reduced value
+    for d in range(n_dev):
+        np.testing.assert_array_equal(out[d], out[0])
+    rel = np.abs(out[0] - exact).max() / np.abs(exact).max()
+    assert rel < 0.02, rel
+    # error feedback residuals are nonzero (there WAS quantization error)
+    assert np.abs(np.asarray(jax.device_get(ef1))).max() > 0
+
+
+def test_error_feedback_unbiases_the_running_mean():
+    """EF acceptance: re-reducing the SAME gradient with carried residuals
+    makes the running mean converge to the exact mean — the compression
+    error cancels instead of accumulating as bias."""
+    n_dev, block = 4, 32
+    rng = np.random.default_rng(6)
+    g = rng.normal(size=(n_dev, 256)).astype(np.float32)
+    L = quant_ar.padded_len(256, n_dev, block)
+    mesh = _dp_mesh(n_dev)
+    fn = quant_ar.quantized_pmean_fn(mesh, block)
+    exact = g.mean(axis=0)
+    ef = jnp.zeros((n_dev, L), jnp.float32)
+    acc = np.zeros_like(exact)
+    one_shot = None
+    steps = 16
+    for i in range(steps):
+        out, ef = fn(jnp.asarray(g), ef)
+        got = np.asarray(jax.device_get(out))[0]
+        if one_shot is None:
+            one_shot = np.abs(got - exact).max()
+        acc += got
+    running = np.abs(acc / steps - exact).max()
+    assert running < one_shot / 4, (running, one_shot)
+
+
+def test_quant_grads_trainer_tracks_exact_trajectory():
+    """Acceptance (_traj_parity-style): a CPU-mesh run with quant_grads
+    stays loss-finite and within a bounded divergence of the exact-psum
+    trajectory on the identical stream."""
+    from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+
+    mesh = _dp_mesh(4)
+
+    def run(qg):
+        cfg = CrossCoderConfig(
+            d_in=32, dict_size=64, batch_size=64, num_tokens=64 * 40,
+            enc_dtype="fp32", lr=1e-3, l1_coeff=0.1, log_backend="null",
+            data_axis_size=4, model_axis_size=1, quant_grads=qg,
+            quant_block=32, prefetch=False,
+        )
+        from crosscoder_tpu.train.trainer import Trainer
+
+        tr = Trainer(cfg, SyntheticActivationSource(cfg), mesh=mesh)
+        if qg:
+            assert "quant_ef" in tr.state.aux
+        out = []
+        for _ in range(20):
+            out.append(float(jax.device_get(tr.step()["loss"])))
+        tr.close()
+        return np.asarray(out)
+
+    lq, lb = run(True), run(False)
+    assert np.isfinite(lq).all()
+    rel = np.abs(lq - lb) / np.maximum(np.abs(lb), 1e-9)
+    assert rel.max() < 5e-3, rel.max()
+
+
+def test_quant_grads_comm_model_halves_grad_sync_bytes():
+    """Acceptance: the compiled-HLO model shows ~2x fewer collective
+    OUTPUT bytes and <=0.5x modeled wire bytes for the DP grad sync."""
+    from crosscoder_tpu.parallel import comm_model
+
+    profs = comm_model.profile_width(
+        4, dict_size=2**10, batch_size=256, programs=("train", "train_quant")
+    )
+    base = next(p for p in profs if p.program == "train_dp")
+    q = next(p for p in profs if p.program == "train_dp_quant")
+    assert q.bytes_by_op["all-to-all"] > 0          # the int8 exchange exists
+    assert q.bytes_by_op["all-gather"] > 0
+    ratio = q.total_bytes / base.total_bytes
+    assert ratio < 0.6, ratio
+    wire_ratio = comm_model.wire_bytes(q) / comm_model.wire_bytes(base)
+    assert wire_ratio < 0.5, wire_ratio
+
+
+def test_quant_grads_checkpoint_roundtrip(tmp_path):
+    """quant_ef residuals live in TrainState.aux and must survive
+    save→restore (same-width mesh), so a resumed quant run keeps its
+    error-feedback state instead of re-biasing from zero."""
+    from crosscoder_tpu.checkpoint import Checkpointer
+    from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+    from crosscoder_tpu.train.trainer import Trainer
+
+    mesh = _dp_mesh(4)
+    cfg = CrossCoderConfig(
+        d_in=32, dict_size=64, batch_size=64, num_tokens=64 * 40,
+        enc_dtype="fp32", lr=1e-3, l1_coeff=0.1, log_backend="null",
+        data_axis_size=4, model_axis_size=1, quant_grads=True,
+        quant_block=32, prefetch=False, checkpoint_dir=str(tmp_path),
+    )
+    tr = Trainer(cfg, SyntheticActivationSource(cfg), mesh=mesh,
+                 checkpointer=Checkpointer(cfg=cfg))
+    for _ in range(3):
+        tr.step()
+    ef_before = {k: np.asarray(jax.device_get(v))
+                 for k, v in tr.state.aux["quant_ef"].items()}
+    assert any(np.abs(v).max() > 0 for v in ef_before.values())
+    tr.save()
+    tr.close()
+
+    tr2 = Trainer(cfg, SyntheticActivationSource(cfg), mesh=mesh,
+                  checkpointer=Checkpointer(cfg=cfg))
+    tr2.restore()
+    for k, v in ef_before.items():
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(tr2.state.aux["quant_ef"][k])), v
+        )
+    assert tr2.step_counter == 3
+    tr2.close()
+
+
+# ---------------------------------------------------------------------------
+# zero-cost when off (mirrors test_resilience.py's fast-path tests)
+
+
+def test_step_hlo_independent_of_quant_config():
+    """The compiled train step must not change when quant knobs are
+    present-but-off (quant_buffer is a data-plane flag; quant_block is
+    inert without a consumer): byte-identical HLO, and no int8 anywhere
+    in the off-path program."""
+    import jax.numpy as jnp
+
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train.trainer import make_train_step
+
+    texts = []
+    for extra in ({}, dict(quant_buffer=True, quant_block=8)):
+        cfg = CrossCoderConfig(d_in=8, dict_size=32, batch_size=32,
+                               enc_dtype="fp32", **extra)
+        mesh = mesh_lib.make_mesh(devices=jax.devices()[:1])
+        tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+        state = jax.eval_shape(lambda k: init_train_state(k, cfg, tx),
+                               jax.random.key(0))
+        shardings = mesh_lib.state_shardings(mesh, state, cfg.shard_sources)
+        step = make_train_step(cfg, mesh, tx, shardings)
+        state_sh = jax.tree_util.tree_map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            state, shardings,
+        )
+        batch = jax.ShapeDtypeStruct(
+            (cfg.batch_size, cfg.n_sources, cfg.d_in), jnp.float32,
+            sharding=mesh_lib.batch_sharding(mesh),
+        )
+        scale = jax.ShapeDtypeStruct(
+            (cfg.n_sources,), jnp.float32,
+            sharding=NamedSharding(mesh, P()),
+        )
+        texts.append(step.lower(state_sh, batch, scale).as_text())
+    assert texts[0] == texts[1]
+    assert "s8[" not in texts[0]
+
+
+def test_quant_off_selects_untouched_classes_and_adds_no_transfers(
+    lm_pair, tokens, monkeypatch
+):
+    """With quant off, make_buffer returns the pre-quantization classes
+    (no quantized state allocated anywhere) and the serve path performs
+    ZERO extra host↔device transfers: the device store serves without a
+    single device_get, the host store fetches exactly one chunk per
+    drained harvest chunk."""
+    lm_cfg, params = lm_pair
+    b_dev = make_buffer(make_cfg(buffer_device="hbm"), lm_cfg, params, tokens)
+    b_host = make_buffer(make_cfg(), lm_cfg, params, tokens)
+    assert type(b_dev) is buffer_mod.DevicePairedActivationBuffer
+    assert type(b_host) is buffer_mod.PairedActivationBuffer
+    for b in (b_dev, b_host):
+        assert not hasattr(b, "_store_q") and not hasattr(b, "_store_scale")
+
+    fetches = []
+    real_get = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: (fetches.append(1), real_get(x))[1])
+    drains = []
+    real_drain = buffer_mod.PairedActivationBuffer._drain_one
+    monkeypatch.setattr(
+        buffer_mod.PairedActivationBuffer, "_drain_one",
+        lambda self: (drains.append(1), real_drain(self))[1],
+    )
+    for _ in range(6):
+        b_dev.next_raw()                    # device store: zero device_get
+    assert fetches == []
+    for _ in range(6):
+        b_host.next_raw()                   # host store: one fetch per drain
+    assert len(fetches) == len(drains), (len(fetches), len(drains))

@@ -7,7 +7,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from crosscoder_tpu.parallel import shard_map_compat as shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from crosscoder_tpu.models import lm
