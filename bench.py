@@ -7,8 +7,12 @@ stray prints are rerouted to stderr for the whole run):
 - **step**: the bare train step on device-resident batches (round-1's
   headline; BASELINE.json config 1 — dict 2^15, batch 4096, bf16).
 - **matrix**: the sparse tier at the training-step level — activation
-  {relu, topk dense, topk pallas, topk+sparse_decode} × dict
-  {2^15, 2^16, 2^17} (BASELINE.json config 2 is TopK k=32 @ 2^15).
+  {relu, topk dense, topk pallas, topk+sparse_decode, topk+sparse_bwd,
+  batchtopk (dense + pallas)} × dict {2^15, 2^16, 2^17} (BASELINE.json
+  config 2 is TopK k=32 @ 2^15). Kernel-heavy legs also report a
+  fwd/bwd split (``fwd_ms``/``bwd_ms`` of the model loss alone) — the
+  sparse backward plane (cfg.sparse_bwd) only changes bwd_ms, so the
+  split is the attribution the step-level number can't give.
 - **configs**: all five BASELINE.json scale-out configs at the
   train-step level (ref shape / topk / 9B-width / 3-way / multi-layer).
 - **e2e**: the pipeline the reference actually runs (reference
@@ -43,6 +47,7 @@ the int8 replay store), QUANT_RELMSE_BOUND.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -184,6 +189,59 @@ def bench_step(cfg, n_steps: int, warmup: int = 3) -> dict:
     }
 
 
+@contextlib.contextmanager
+def _env(overrides: dict):
+    """Set env vars for one bench leg (the kernel opt-in gates —
+    CROSSCODER_SPARSE_GRAD_PALLAS etc. are read at trace time), restoring
+    the previous values on exit so legs can't leak into each other."""
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def bench_fwd_bwd(cfg, n_steps: int, warmup: int = 2) -> dict:
+    """Forward/backward split of the MODEL cost: the jitted bare loss
+    (``training_loss``, no optimizer/collectives — so fwd+bwd < step_ms)
+    and its grad, timed separately; ``bwd_ms`` is the difference. This is
+    the attribution the step-level number can't give: the sparse backward
+    plane (cfg.sparse_bwd, docs/SCALING.md "Sparse backward plane")
+    replaces backward matmuls only, so its whole win must land in
+    ``bwd_ms`` while ``fwd_ms`` stays put."""
+    from crosscoder_tpu.models import crosscoder as cc
+
+    params = cc.init_params(jax.random.key(cfg.seed), cfg)
+    x = jax.random.normal(
+        jax.random.key(1), (cfg.batch_size, cfg.n_sources, cfg.d_in),
+        dtype=jnp.float32,
+    )
+    l1 = float(cfg.l1_coeff)
+
+    def loss(p, xb):
+        return cc.training_loss(p, xb, l1, cfg, with_metrics=False)[0]
+
+    out = {}
+    for name, fn in (("fwd_ms", jax.jit(loss)),
+                     ("fwdbwd_ms", jax.jit(jax.grad(loss)))):
+        r = None
+        for _ in range(warmup):
+            r = fn(params, x)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            r = fn(params, x)
+        jax.block_until_ready(r)
+        out[name] = round(1000 * (time.perf_counter() - t0) / n_steps, 2)
+    out["bwd_ms"] = round(out["fwdbwd_ms"] - out["fwd_ms"], 2)
+    return out
+
+
 def section_step() -> dict:
     cfg = _make_cfg()
     out = bench_step(cfg, int(os.environ.get("BENCH_STEPS", 50)))
@@ -252,15 +310,37 @@ def section_matrix() -> list[dict]:
     from crosscoder_tpu.ops import activations as act_ops
 
     on_tpu = jax.default_backend() == "tpu"
+    # (label, cfg overrides, topk impl, env for the leg). A non-empty env
+    # is a kernel opt-in gate (ships conservative-default, see
+    # ops/sparse_grad.py / topk_pallas.batchtopk_kernel_enabled) — those
+    # legs are TPU-only: timing the interpret path or a silent dense
+    # fallback under a kernel label would be a lie.
     variants = [
-        ("relu", dict(activation="relu"), "auto"),
-        ("topk_dense", dict(activation="topk", topk_k=32, l1_coeff=0.0), "dense"),
-        ("topk_pallas", dict(activation="topk", topk_k=32, l1_coeff=0.0), "pallas"),
+        ("relu", dict(activation="relu"), "auto", {}),
+        ("topk_dense", dict(activation="topk", topk_k=32, l1_coeff=0.0),
+         "dense", {}),
+        ("topk_pallas", dict(activation="topk", topk_k=32, l1_coeff=0.0),
+         "pallas", {}),
         ("topk_sparse_decode",
          dict(activation="topk", topk_k=32, l1_coeff=0.0, sparse_decode=True),
-         "auto"),
-        ("batchtopk", dict(activation="batchtopk", topk_k=32, l1_coeff=0.0), "auto"),
-        ("jumprelu", dict(activation="jumprelu", l1_coeff=0.0), "auto"),
+         "auto", {}),
+        # the sparse backward plane (tentpole of the scatter-accumulate PR):
+        # identical forward to topk_pallas + factored tier, backward through
+        # ops/sparse_grad.py — step_ms vs topk_pallas is the headline A/B,
+        # bwd_ms vs topk_pallas's carries the attribution
+        ("topk_sparse_bwd",
+         dict(activation="topk", topk_k=32, l1_coeff=0.0, sparse_bwd="on",
+              factored_decode="on"),
+         "pallas", {"CROSSCODER_SPARSE_GRAD_PALLAS": "1"}),
+        ("batchtopk", dict(activation="batchtopk", topk_k=32, l1_coeff=0.0),
+         "auto", {}),
+        # BatchTopK through the chunked Pallas global-threshold kernels
+        # (bit-identical mask; closes the "BatchTopK unkerneled at wide
+        # dicts" residue)
+        ("batchtopk_pallas",
+         dict(activation="batchtopk", topk_k=32, l1_coeff=0.0),
+         "auto", {"CROSSCODER_BATCHTOPK_PALLAS": "1"}),
+        ("jumprelu", dict(activation="jumprelu", l1_coeff=0.0), "auto", {}),
         # AuxK step cost: aux_dead_steps=1 keeps the dead set non-empty so
         # aux-on steps include the full aux path (approx_max_k ranking
         # over the masked [B,H] pre-acts, dense-matmul aux decode, fired
@@ -276,16 +356,32 @@ def section_matrix() -> list[dict]:
         ("topk_auxk",
          dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
               aux_dead_steps=1, aux_every=8),
-         "auto"),
+         "auto", {}),
         ("topk_auxk_perstep",
          dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
               aux_dead_steps=1, aux_mask_every=0),
-         "auto"),
+         "auto", {}),
         ("topk_auxk_perstep_exact",
          dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
               aux_dead_steps=1),
-         "auto"),
+         "auto", {}),
+        # sparse backward under the per-step AuxK recipe: the main tier
+        # runs the (h, W_dec)-scoped sparse variant, the aux term reuses
+        # the scatter plane when use_sparse_aux's gates pass (at B=4096,
+        # aux_k=256 the 1M-pair aux list exceeds the kernel's VMEM cap,
+        # so the aux VJP stays dense — the partial win of the
+        # "re-measure topk_auxk_perstep" satellite; BENCH_r05: 391.43 ms)
+        ("topk_auxk_perstep_sparse_bwd",
+         dict(activation="topk", topk_k=32, l1_coeff=0.0, aux_k=256,
+              aux_dead_steps=1, aux_mask_every=0, sparse_bwd="on",
+              factored_decode="on"),
+         "pallas", {"CROSSCODER_SPARSE_GRAD_PALLAS": "1"}),
     ]
+    # legs that also report the fwd/bwd model-loss split (compiles two
+    # extra programs per entry, so only where the split answers a
+    # question: the sparse-backward A/B pair and the dense floor)
+    split_fwd_bwd = {"topk_pallas", "topk_sparse_bwd", "jumprelu",
+                     "batchtopk", "batchtopk_pallas"}
     steps = int(os.environ.get("BENCH_MATRIX_STEPS", 16))
     dicts = tuple(
         int(x) for x in os.environ.get(
@@ -300,7 +396,10 @@ def section_matrix() -> list[dict]:
             except Exception as e:
                 out.append({"dict_size": dict_size, "parity_ok": False,
                             "error": f"{type(e).__name__}: {str(e)[:200]}"})
-        for label, overrides, impl in variants:
+        for label, overrides, impl, env in variants:
+            if env and not on_tpu:
+                continue               # kernel opt-in legs are TPU-only
+            cfg = _make_cfg(dict_size=dict_size, **overrides)
             if impl == "pallas":
                 from crosscoder_tpu.ops import topk_pallas
 
@@ -314,11 +413,37 @@ def section_matrix() -> list[dict]:
                     out.append({"variant": label, "dict_size": dict_size,
                                 "skipped": "kernel unsupported at this width"})
                     continue
+            if cfg.sparse_bwd == "on":
+                # sparse_bwd="on" with an unsupported scatter shape falls
+                # back to the XLA scatter — sparse math but the measured-
+                # slow path; don't time it under the sparse_bwd label
+                from crosscoder_tpu.ops import sparse_grad, topk_pallas
+
+                if not (topk_pallas.sparsify_supported(dict_size, cfg.topk_k)
+                        and sparse_grad.decode_grad_supported(
+                            dict_size, cfg.topk_k, cfg.n_sources, cfg.d_in,
+                            cfg.batch_size)):
+                    out.append({"variant": label, "dict_size": dict_size,
+                                "skipped": "scatter kernel unsupported at "
+                                           "this shape"})
+                    continue
+            if label == "batchtopk_pallas":
+                from crosscoder_tpu.ops import topk_pallas
+
+                probe = jax.ShapeDtypeStruct(
+                    (cfg.batch_size, dict_size), jnp.bfloat16)
+                if not topk_pallas.batchtopk_supported(probe, cfg.topk_k):
+                    out.append({"variant": label, "dict_size": dict_size,
+                                "skipped": "batchtopk kernel unsupported at "
+                                           "this width"})
+                    continue
             act_ops.set_topk_impl(impl)
             try:
-                r = bench_step(_make_cfg(dict_size=dict_size, **overrides),
-                               steps, warmup=2)
-                entry = {"variant": label, "dict_size": dict_size, **r}
+                with _env(env):
+                    r = bench_step(cfg, steps, warmup=2)
+                    entry = {"variant": label, "dict_size": dict_size, **r}
+                    if label in split_fwd_bwd:
+                        entry.update(bench_fwd_bwd(cfg, steps))
             except Exception as e:     # one OOM must not kill the bench
                 entry = {"variant": label, "dict_size": dict_size,
                          "error": f"{type(e).__name__}: {str(e)[:200]}"}

@@ -92,6 +92,20 @@ class CrossCoderConfig:
                                     # 2^16); "on"/"off" force. Requires
                                     # l1_coeff == 0 (see
                                     # models.crosscoder._factored_topk_forward)
+    sparse_bwd: str = "auto"        # topk factored tier: replace the dense
+                                    # backward matmuls (dW_dec, df, dW_enc)
+                                    # with O(B·k) Pallas scatter-accumulate
+                                    # gradients (ops/sparse_grad.py;
+                                    # docs/SCALING.md "Sparse backward
+                                    # plane"). "auto" = on when the
+                                    # factored tier is active AND the
+                                    # scatter kernel is live (TPU +
+                                    # CROSSCODER_SPARSE_GRAD_PALLAS=1, or
+                                    # interpret mode) AND shapes are
+                                    # kernel-supported; "on" forces (also
+                                    # forces the factored tier); "off"
+                                    # never. Requires l1_coeff == 0 (the
+                                    # factored tier's soundness gate).
     jumprelu_theta: float = 0.001   # initial JumpReLU threshold
     jumprelu_bandwidth: float = 0.001  # STE bandwidth for the threshold gradient
     l0_coeff: float = 0.0           # jumprelu only: coefficient on the
@@ -385,6 +399,35 @@ class CrossCoderConfig:
                 "factored_decode='on' requires l1_coeff=0: the factored "
                 "forward's custom VJP carries no gradient path through "
                 "(vals, idx), which a nonzero weighted-L1 objective needs"
+            )
+        if self.sparse_bwd not in ("auto", "on", "off"):
+            import difflib
+
+            close = difflib.get_close_matches(
+                str(self.sparse_bwd), ("auto", "on", "off"), n=1
+            )
+            hint = f"; did you mean {close[0]!r}?" if close else ""
+            raise ValueError(
+                f"sparse_bwd must be auto|on|off, got {self.sparse_bwd!r}{hint}"
+            )
+        if self.sparse_bwd == "on" and self.activation != "topk":
+            raise ValueError(
+                f"sparse_bwd='on' requires activation='topk' (the sparse "
+                f"backward consumes the factored (vals, idx) the TopK tier "
+                f"produces), got {self.activation!r}"
+            )
+        if self.sparse_bwd == "on" and self.l1_coeff != 0:
+            raise ValueError(
+                "sparse_bwd='on' requires l1_coeff=0: like the factored "
+                "tier it extends, its custom VJP carries no gradient path "
+                "through (vals, idx), which a nonzero weighted-L1 "
+                "objective needs"
+            )
+        if self.sparse_bwd == "on" and self.sparse_decode:
+            raise ValueError(
+                "sparse_bwd='on' is incompatible with sparse_decode: the "
+                "sparse backward extends the factored Pallas tier, not the "
+                "legacy gather decode (which has its own custom VJP)"
             )
         if self.l0_coeff > 0 and self.activation != "jumprelu":
             raise ValueError(

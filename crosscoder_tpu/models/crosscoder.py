@@ -334,6 +334,270 @@ def _factored_topk_bwd(k, res, g):
 _factored_topk_forward.defvjp(_factored_topk_fwd, _factored_topk_bwd)
 
 
+# ---------------------------------------------------------------------------
+# sparse backward plane (cfg.sparse_bwd; ops/sparse_grad.py): the factored
+# tier with the dense backward matmuls replaced by O(B·k) scatter-
+# accumulates. The dense factored backward (_factored_topk_bwd above) runs
+# dW_dec [B,H]x[B,nd] + df [B,nd]x[H,nd] — and the encoder VJP behind it
+# runs dW_enc [B,nd]x[B,H] — three matmuls that each multiply ~99.9%
+# structural zeros at TopK(k=32), dict 2^17. With (vals, idx) in hand the
+# same gradients are B·k-pair scatter/gathers:
+#
+#   d_vals[b,j] = <g[b], W_dec[idx[b,j]]>          (gather + [B,k,nd] einsum)
+#   dW_dec[idx[b,j]] += vals[b,j] · g[b]           (scatter_add_rows)
+#   dW_enc[:, :, idx[b,j]] += d_vals[b,j] · x[b]   (scatter_add_rows, with a
+#   db_enc[idx[b,j]] += d_vals[b,j]                 ones column riding along)
+#
+# accumulated in f32 with deterministic within-block ordering (the kernel
+# sorts pairs by destination, stable). Gradients equal the dense backward's
+# up to f32 summation order — asserted in tests/test_sparse_grad.py,
+# including the duplicate-index (two rows activating the same latent) case.
+#
+# Two variants, same split as the factored forward pair above:
+# - _sparse_topk_step: owns encode AND decode (x, W_enc, b_enc, W_dec), so
+#   ALL THREE backward matmuls disappear. Used on bare steps (no AuxK this
+#   step) — the throughput-defining variant. dx is computed exactly (a
+#   k-row gather of W_enc) and DCE'd by XLA when only params are
+#   differentiated, which is every training step.
+# - _sparse_topk_from_h: (h, W_dec) only, used when another consumer needs
+#   the pre-acts differentiably (the AuxK ranking/gather). dh is scattered
+#   back to [B, H] (the one scatter this variant keeps) and dW_enc flows
+#   through the ordinary encoder VJP.
+#
+# Soundness gate is the factored tier's (l1_coeff == 0: no gradient path
+# through (vals, idx) cotangents).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _sparse_topk_step(
+    x: jax.Array, W_enc: jax.Array, b_enc: jax.Array, W_dec: jax.Array,
+    k: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """``(recon [B,n,d] f32 (no b_dec), vals [B,k], idx [B,k])`` from the
+    batch ``x [B,n,d]`` — encode + TopK + factored decode in one
+    custom-vjp scope so the backward never leaves factored form."""
+    from crosscoder_tpu.ops import topk_pallas
+
+    hf = jnp.einsum("bnd,ndh->bh", x, W_enc,
+                    preferred_element_type=jnp.float32)
+    h = (hf + b_enc.astype(jnp.float32)).astype(x.dtype)
+    f = topk_pallas.topk(h, k)
+    vals, idx = topk_pallas.sparsify(f, k)
+    w = jnp.take(W_dec, idx, axis=0)                       # [B, k, n, d]
+    recon = jnp.einsum("bk,bknd->bnd", vals, w,
+                       preferred_element_type=jnp.float32)
+    return recon, vals, idx
+
+
+def _sparse_topk_step_fwd(x, W_enc, b_enc, W_dec, k):
+    out = _sparse_topk_step(x, W_enc, b_enc, W_dec, k)
+    _, vals, idx = out
+    # residuals are FACTORED: (vals, idx) [B,k] replace the [B,H] masked
+    # activations the dense backward keeps — ~H/k less residual memory.
+    # (b_tok: zero-size dtype token — residual leaves must be arrays.)
+    return out, (x, vals, idx, W_enc, W_dec, jnp.zeros((0,), b_enc.dtype))
+
+
+def _sparse_topk_step_bwd(k, res, g):
+    from crosscoder_tpu.ops import sparse_grad
+
+    x, vals, idx, W_enc, W_dec, b_tok = res
+    b_dtype = b_tok.dtype
+    g_recon = g[0].astype(jnp.float32)                     # [B, n, d]
+    # cotangents g[1], g[2] (vals, idx) are ignored — soundness gated on
+    # l1_coeff == 0, exactly like _factored_topk_forward
+    B = vals.shape[0]
+    H, n, d = W_dec.shape
+    nd = n * d
+    g_flat = g_recon.reshape(B, nd)
+
+    # d_vals through the k active decoder rows, straight-through masked on
+    # the survivors (vals > 0; padded slots carry val 0 and drop out —
+    # the same rule as the dense path's f > 0 mask)
+    w = jnp.take(W_dec, idx, axis=0).astype(jnp.float32)   # [B, k, n, d]
+    d_vals = jnp.einsum("bnd,bknd->bk", g_recon, w)
+    d_vals = jnp.where(vals > 0, d_vals, 0.0)              # [B, k] f32
+
+    # decoder gradient: B·k scatter-accumulate instead of [B,H]x[B,nd]
+    dW_dec = sparse_grad.scatter_add_rows(
+        vals.astype(jnp.float32), idx, g_flat, H
+    ).reshape(H, n, d).astype(W_dec.dtype)
+
+    # encoder gradients from the k-sparse dh: one scatter over the batch
+    # rows, with a ones column appended (lane-padded to 128) so db_enc
+    # rides the same accumulation instead of needing its own scatter
+    x_flat = x.reshape(B, nd).astype(jnp.float32)
+    ones_col = (jax.lax.broadcasted_iota(jnp.int32, (B, 128), 1) == 0
+                ).astype(jnp.float32)
+    x_aug = jnp.concatenate([x_flat, ones_col], axis=1)    # [B, nd + 128]
+    enc_grads = sparse_grad.scatter_add_rows(d_vals, idx, x_aug, H)
+    dW_enc = jnp.transpose(
+        enc_grads[:, :nd].reshape(H, n, d), (1, 2, 0)
+    ).astype(W_enc.dtype)
+    db_enc = enc_grads[:, nd].astype(b_dtype)
+
+    # dx exactly (k-row gather of W_enc); XLA DCEs this whole branch when
+    # only params are differentiated — i.e. on every training step
+    we = jnp.take(W_enc, idx.reshape(-1), axis=2).reshape(n, d, B, k)
+    dx = jnp.einsum("bk,ndbk->bnd", d_vals, we.astype(jnp.float32)
+                    ).astype(x.dtype)
+    return dx, dW_enc, db_enc, dW_dec
+
+
+_sparse_topk_step.defvjp(_sparse_topk_step_fwd, _sparse_topk_step_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _sparse_topk_from_h(
+    h: jax.Array, W_dec: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The (h, W_dec)-scoped sparse-backward variant: same forward as
+    ``_factored_topk_forward``, backward with the dense dW_dec/df matmuls
+    replaced by the scatter/gather pair. ``dh`` is materialized [B, H]
+    (one scatter) because ``h`` has other consumers on this path (the
+    AuxK ranking) — the full-step variant above avoids even that."""
+    from crosscoder_tpu.ops import topk_pallas
+
+    f = topk_pallas.topk(h, k)
+    vals, idx = topk_pallas.sparsify(f, k)
+    w = jnp.take(W_dec, idx, axis=0)
+    recon = jnp.einsum("bk,bknd->bnd", vals, w,
+                       preferred_element_type=jnp.float32)
+    return recon, vals, idx
+
+
+def _sparse_topk_from_h_fwd(h, W_dec, k):
+    out = _sparse_topk_from_h(h, W_dec, k)
+    _, vals, idx = out
+    # h_tok: zero-size dtype token (residual leaves must be arrays); the
+    # dh shape is recoverable as (vals batch, W_dec rows)
+    return out, (vals, idx, W_dec, jnp.zeros((0,), h.dtype))
+
+
+def _sparse_topk_from_h_bwd(k, res, g):
+    from crosscoder_tpu.ops import sparse_grad
+
+    vals, idx, W_dec, h_tok = res
+    h_shape = (vals.shape[0], W_dec.shape[0])
+    h_dtype = h_tok.dtype
+    g_recon = g[0].astype(jnp.float32)
+    B = vals.shape[0]
+    H, n, d = W_dec.shape
+    w = jnp.take(W_dec, idx, axis=0).astype(jnp.float32)
+    d_vals = jnp.einsum("bnd,bknd->bk", g_recon, w)
+    d_vals = jnp.where(vals > 0, d_vals, 0.0)
+    dW_dec = sparse_grad.scatter_add_rows(
+        vals.astype(jnp.float32), idx, g_recon.reshape(B, n * d), H
+    ).reshape(H, n, d).astype(W_dec.dtype)
+    rows = jnp.arange(B)[:, None]
+    dh = jnp.zeros(h_shape, h_dtype).at[rows, idx].add(
+        d_vals.astype(h_dtype), mode="drop"
+    )
+    return dh, dW_dec
+
+
+_sparse_topk_from_h.defvjp(_sparse_topk_from_h_fwd, _sparse_topk_from_h_bwd)
+
+
+@jax.custom_vjp
+def _sparse_aux_product(avals: jax.Array, aidx: jax.Array,
+                        W_dec: jax.Array) -> jax.Array:
+    """AuxK decode ``e_hat [B,n,d] f32`` with the SPARSE backward.
+
+    Forward is byte-identical to the dense aux path (scatter the aux
+    activations to [B, H], one MXU matmul — the measured-best forward at
+    aux_k ≈ 8k, see the dense-decode note in get_losses); only the two
+    backward matmuls are replaced: ``d_avals`` through the aux_k gathered
+    rows, ``dW_dec`` through the scatter-accumulate plane.
+    """
+    B = avals.shape[0]
+    H = W_dec.shape[0]
+    rows = jnp.arange(B)[:, None]
+    f_aux = jnp.zeros((B, H), avals.dtype).at[rows, aidx].add(avals)
+    return jnp.einsum("bh,hnd->bnd", f_aux, W_dec,
+                      preferred_element_type=jnp.float32)
+
+
+def _sparse_aux_product_fwd(avals, aidx, W_dec):
+    return _sparse_aux_product(avals, aidx, W_dec), (avals, aidx, W_dec)
+
+
+def _sparse_aux_product_bwd(res, g):
+    from crosscoder_tpu.ops import sparse_grad
+
+    avals, aidx, W_dec = res
+    gf = g.astype(jnp.float32)                             # [B, n, d]
+    B = avals.shape[0]
+    H, n, d = W_dec.shape
+    w = jnp.take(W_dec, aidx, axis=0).astype(jnp.float32)  # [B, ak, n, d]
+    d_avals = jnp.einsum("bnd,bknd->bk", gf, w).astype(avals.dtype)
+    dW_dec = sparse_grad.scatter_add_rows(
+        avals.astype(jnp.float32), aidx, gf.reshape(B, n * d), H
+    ).reshape(H, n, d).astype(W_dec.dtype)
+    return d_avals, None, dW_dec
+
+
+_sparse_aux_product.defvjp(_sparse_aux_product_fwd, _sparse_aux_product_bwd)
+
+
+def use_sparse_bwd(cfg: CrossCoderConfig, batch: int | None = None) -> bool:
+    """Dispatch for the sparse backward plane (``cfg.sparse_bwd``).
+
+    Applies on top of the factored tier (callers AND the factored gate
+    must agree — ``get_losses`` computes ``factored and use_sparse_bwd``).
+    "off" never; "on" whenever sound (forced — CPU parity tests and
+    forced A/Bs; unsupported shapes fall back to the XLA scatter inside
+    scatter_add_rows, still sparse math); "auto" additionally requires
+    the Pallas scatter kernel to be live (interpret mode, or TPU with
+    ``CROSSCODER_SPARSE_GRAD_PALLAS=1`` — the ops/quant.py hardware gate)
+    and, when the batch size is known, kernel-supported shapes for both
+    scatter calls — without the kernel, a sparse backward IS the measured
+    42-76 ms XLA scatter the dense matmuls beat.
+    Soundness: the factored tier's l1_coeff == 0 gate.
+    """
+    if cfg.activation != "topk" or cfg.sparse_decode:
+        return False
+    if cfg.sparse_bwd == "off" or cfg.l1_coeff != 0:
+        return False
+    if cfg.sparse_bwd == "on":
+        return True
+    from crosscoder_tpu.ops import sparse_grad
+
+    if not sparse_grad.kernel_enabled():
+        return False
+    if batch is not None and not sparse_grad.decode_grad_supported(
+        cfg.dict_size, cfg.topk_k, cfg.n_sources, cfg.d_in, batch
+    ):
+        return False
+    return True
+
+
+def use_sparse_aux(cfg: CrossCoderConfig, batch: int) -> bool:
+    """Sparse backward for the AuxK aux term. Requires the sparse plane
+    active ("on"/live-"auto") AND kernel-supported aux shapes — the
+    B·aux_k pair list must be VMEM-resident (sparse_grad._MAX_PAIRS;
+    aux_k ≈ 8k at batch 4096 is ~32× over the cap, and the XLA fallback
+    would materialize a [B·aux_k, n·d] f32 update matrix, so the support
+    gate is hard even under forced "on" — unsupported aux falls back to
+    the dense aux VJP, which is the measured-best dense path anyway).
+    "auto" additionally applies the traffic heuristic
+    ``aux_k · 512 <= dict_size``: the sparse backward's pair-gather bytes
+    beat the dense VJP matmuls only once the dictionary is ~500× the aux
+    width (v5e flop:byte ratio ≈ 250, ×2 for the two matmuls replaced) —
+    provisional until a hardware A/B lands."""
+    if cfg.aux_k <= 0 or not use_sparse_bwd(cfg):
+        return False
+    from crosscoder_tpu.ops import sparse_grad
+
+    k_aux = min(cfg.aux_k, cfg.dict_size)
+    aux_ok = sparse_grad.supported(
+        cfg.dict_size, cfg.n_sources * cfg.d_in, batch, batch * k_aux
+    )
+    if cfg.sparse_bwd == "on":
+        return aux_ok
+    return aux_ok and cfg.aux_k * 512 <= cfg.dict_size
+
+
 def use_factored_decode(cfg: CrossCoderConfig) -> bool:
     """Dispatch for the factored TopK decode tier.
 
@@ -360,7 +624,11 @@ def use_factored_decode(cfg: CrossCoderConfig) -> bool:
         return False
     if not topk_pallas.sparsify_supported(cfg.dict_size, cfg.topk_k):
         return False
-    return mode == "on" or cfg.dict_size >= 131072
+    # sparse_bwd="on" forces the factored tier too (the sparse backward
+    # plane extends it — the factored (vals, idx) ARE its inputs), so a
+    # forced sparse backward at sub-2^17 dicts doesn't silently noop
+    return (mode == "on" or cfg.dict_size >= 131072
+            or cfg.sparse_bwd == "on")
 
 
 def topk_vals_idx(params: Params, x: jax.Array, cfg: CrossCoderConfig) -> tuple[jax.Array, jax.Array]:
@@ -423,13 +691,26 @@ def get_losses(
                         # JumpReLU L0 penalty, the AuxK ranking) needs
                         # them — shared explicitly rather than trusting
                         # CSE to dedupe a second encode matmul
-    if factored:
+    aux_active = dead_mask is not None and cfg.aux_k > 0
+    sparse_bwd = factored and use_sparse_bwd(cfg, x.shape[0])
+    if factored and sparse_bwd and not aux_active:
+        # sparse backward plane, full-step scope: encode + TopK + factored
+        # decode under ONE custom vjp (ops/sparse_grad.py) — none of the
+        # three dense backward matmuls survives. Forward numerics are the
+        # factored tier's exactly (same einsum/kernel/gather chain).
+        recon_f32, vals, idx = _sparse_topk_step(
+            x, params["W_enc"], params["b_enc"], params["W_dec"], cfg.topk_k
+        )
+        recon = (recon_f32 + params["b_dec"].astype(jnp.float32)).astype(x.dtype)
+        f = None
+    elif factored:
         # Pallas factored tier: kernel mask → sparsify → k-row decode;
         # backward identical to the dense path (see _factored_topk_forward)
+        # — or, on sparse-backward AuxK steps, the (h, W_dec)-scoped sparse
+        # variant (h must stay an explicit residual for the aux ranking)
         h = pre_acts(params, x)
-        recon_f32, vals, idx = _factored_topk_forward(
-            h, params["W_dec"], cfg.topk_k
-        )
+        tier = _sparse_topk_from_h if sparse_bwd else _factored_topk_forward
+        recon_f32, vals, idx = tier(h, params["W_dec"], cfg.topk_k)
         recon = (recon_f32 + params["b_dec"].astype(jnp.float32)).astype(x.dtype)
         f = None
     elif sparse:
@@ -536,13 +817,21 @@ def get_losses(
         # hits per dictionary row means every W_dec row is read anyway:
         # three MXU matmuls (fwd + the two VJPs) win outright, the same
         # trade the sparse_decode notes above document for the main path.
-        f_aux = jnp.zeros((x.shape[0], d_hidden), x.dtype).at[
-            jnp.arange(x.shape[0])[:, None], aidx
-        ].add(avals.astype(x.dtype))
-        e_hat = jnp.einsum(
-            "bh,hnd->bnd", f_aux, params["W_dec"],
-            preferred_element_type=jnp.float32,
-        )
+        if use_sparse_aux(cfg, x.shape[0]):
+            # sparse backward reuse (cfg.sparse_bwd): identical dense
+            # forward, backward through the O(B·aux_k) scatter/gather
+            # plane instead of the two [B,H]-sized VJP matmuls
+            e_hat = _sparse_aux_product(
+                avals.astype(x.dtype), aidx, params["W_dec"]
+            )
+        else:
+            f_aux = jnp.zeros((x.shape[0], d_hidden), x.dtype).at[
+                jnp.arange(x.shape[0])[:, None], aidx
+            ].add(avals.astype(x.dtype))
+            e_hat = jnp.einsum(
+                "bh,hnd->bnd", f_aux, params["W_dec"],
+                preferred_element_type=jnp.float32,
+            )
         num = jnp.mean(jnp.sum(jnp.square(e_hat - e), axis=(-2, -1)))
         den = jnp.mean(jnp.sum(jnp.square(e), axis=(-2, -1)))
         # no dead latents → e_hat ≡ 0 and the ratio is a gradient-free

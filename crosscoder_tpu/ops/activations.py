@@ -67,7 +67,7 @@ def _topk_dense(h: jax.Array, k: int) -> jax.Array:
     return out.reshape(*lead, hp.shape[-1])
 
 
-def batchtopk(h: jax.Array, k: int) -> jax.Array:
+def batchtopk(h: jax.Array, k: int, *, use_pallas: bool | None = None) -> jax.Array:
     """TopK over the flattened (batch × d_hidden) pre-acts, keeping
     ``k · batch`` entries globally (ties at the threshold all kept); at eval
     time this behaves like a global threshold (BatchTopK, Bussmann et al.
@@ -79,7 +79,20 @@ def batchtopk(h: jax.Array, k: int) -> jax.Array:
     device sort at the production shape (4096 × 2^15) that XLA cannot tile,
     while each bisection sweep is a plain elementwise-compare + sum
     reduction that fuses and scales to any size.
+
+    When the chunked Pallas kernels are live and the shape is supported
+    (:func:`crosscoder_tpu.ops.topk_pallas.batchtopk_supported`), the
+    bisection + mask run over VMEM-resident tiles instead — bit-identical
+    output, same straight-through gradient.
     """
+    if use_pallas is None:
+        use_pallas = _default_use_pallas()
+    if use_pallas:
+        from crosscoder_tpu.ops import topk_pallas
+
+        if (topk_pallas.batchtopk_kernel_enabled()
+                and topk_pallas.batchtopk_supported(h, k)):
+            return topk_pallas.batchtopk(h, k)
     hp = relu(h)
     thresh = batchtopk_threshold_of(hp, k)
     mask = (hp >= thresh) & (hp > 0)
@@ -214,12 +227,22 @@ def _jumprelu_l0_bwd(bandwidth, res, g):
 jumprelu_l0.defvjp(_jumprelu_l0_fwd, _jumprelu_l0_bwd)
 
 
-def batchtopk_fixed(h: jax.Array, threshold: float) -> jax.Array:
+def batchtopk_fixed(h: jax.Array, threshold: float,
+                    *, use_pallas: bool | None = None) -> jax.Array:
     """BatchTopK EVAL mode: a calibrated fixed global threshold, so one
     example's activations never depend on what else is in the batch
     (Bussmann et al. 2024 use the mean training threshold at inference).
     Calibrate with :func:`crosscoder_tpu.models.crosscoder.
-    calibrate_batchtopk_threshold`."""
+    calibrate_batchtopk_threshold`. Dispatches to the Pallas emit sweep
+    under the same gates as :func:`batchtopk` (bit-identical mask)."""
+    if use_pallas is None:
+        use_pallas = _default_use_pallas()
+    if use_pallas:
+        from crosscoder_tpu.ops import topk_pallas
+
+        if (topk_pallas.batchtopk_kernel_enabled()
+                and topk_pallas.batchtopk_supported(h, 1)):
+            return topk_pallas.batchtopk_fixed(h, float(threshold))
     hp = relu(h)
     mask = (hp >= jnp.asarray(threshold, hp.dtype)) & (hp > 0)
     return hp * jax.lax.stop_gradient(mask.astype(hp.dtype))

@@ -1,0 +1,25 @@
+"""Shared hardware-dispatch gate for the opt-in Pallas kernels.
+
+Every kernel module in ops/ ships interpret-verified but
+hardware-unmeasured (this environment cannot Mosaic-compile), so real-TPU
+dispatch is an explicit opt-in env var per kernel family — one rule,
+stated once: the interpreter (CPU tests) always may run, hardware only
+with the opt-in. Flip a kernel's conservative default here-adjacent (its
+call site) once a real-TPU A/B lands; the GATE shape itself is shared so
+a policy change (new backend, global kill-switch) lands in one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def hw_kernel_enabled(env_var: str, interpret: bool) -> bool:
+    """Whether a Pallas kernel may dispatch: interpret mode (the CPU
+    stand-in used by tests), or a real TPU backend with ``env_var=1``."""
+    return interpret or (
+        jax.default_backend() == "tpu"
+        and os.environ.get(env_var) == "1"
+    )

@@ -195,12 +195,9 @@ def quantize_rows(x: jax.Array, block: int) -> tuple[jax.Array, jax.Array]:
     kernel ships interpret-verified but hardware-unmeasured; flip the
     default once a real-TPU A/B lands — the XLA lowering is a correct
     two-pass fallback either way)."""
-    import os
+    from crosscoder_tpu.ops.dispatch import hw_kernel_enabled
 
-    use_kernel = _INTERPRET or (
-        jax.default_backend() == "tpu"
-        and os.environ.get("CROSSCODER_QUANT_PALLAS") == "1"
-    )
+    use_kernel = hw_kernel_enabled("CROSSCODER_QUANT_PALLAS", _INTERPRET)
     if use_kernel:
         lead = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
         if x.ndim >= 2 and rows_supported(lead, x.shape[-1], block):

@@ -787,3 +787,278 @@ def _topk_vjp_bwd(k, interpret, out, g):
 
 
 topk.defvjp(_topk_vjp_fwd, _topk_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# BatchTopK: GLOBAL-threshold masking through the chunked kernel machinery
+# ---------------------------------------------------------------------------
+#
+# BatchTopK's mask is ``hp >= thresh`` where thresh is the (k·B)-th largest
+# ReLU'd value of the WHOLE batch — one order statistic, not B of them. The
+# dense path (activations._kth_largest_nonneg) bisects with a
+# ``bits[:, None] >= mids[None, :]`` broadcast, materializing a [B·H, T]
+# comparison per pass in HBM; these kernels run the same multi-threshold
+# bisection over VMEM-resident tiles (count accumulation in SMEM scalars —
+# the threshold is global, so the carried state is T+2 scalars, not a
+# per-row vector like _bisect_kernel's), then one emit sweep applying the
+# threshold mask. Same shifted pattern space, same _mids spacing, so the
+# converged threshold is the EXACT (k·B)-th largest pattern — the emit is
+# bit-identical to the dense oracle (asserted in
+# tests/test_batchtopk_pallas.py, including ties at the threshold, which
+# BatchTopK keeps in full — no tie-break pass needed, the reason a global
+# threshold kernelizes so much more cheaply than per-row TopK).
+#
+# Hardware dispatch is gated on ``CROSSCODER_BATCHTOPK_PALLAS=1``
+# (conservative default, the ops/quant.py precedent: this environment
+# cannot Mosaic-compile, so the kernel ships interpret-verified but
+# hardware-unmeasured).
+
+# thresholds per bisection pass: matches activations._BATCHTOPK_T so the
+# kernel and the dense oracle take the same pass schedule (bf16's 15-bit
+# pattern space: 4 passes; f32's 31-bit: 8) — each pass is one read of the
+# matrix, the dominant cost at batchtopk shapes
+_BATCHTOPK_T = 15
+
+
+def batchtopk_kernel_enabled() -> bool:
+    """Whether the BatchTopK kernels may dispatch: the interpreter (CPU
+    tests) or a real TPU with the opt-in env set (the shared
+    ops/dispatch gate)."""
+    from crosscoder_tpu.ops.dispatch import hw_kernel_enabled
+
+    return hw_kernel_enabled("CROSSCODER_BATCHTOPK_PALLAS", _INTERPRET)
+
+
+def batchtopk_supported(h: jax.Array, k: int) -> bool:
+    """Shapes the global-threshold kernels handle: kernel dtypes and a
+    lane-aligned width that is chunk-divisible or a single VMEM-sized
+    chunk (the sparsify/_chunked gate geometry)."""
+    if h.ndim < 2 or h.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    width = h.shape[-1]
+    return (
+        k > 0
+        and width % 128 == 0
+        and width >= 256
+        and (width % _CHUNK_WIDTH == 0 or width <= 8192)
+    )
+
+
+def _mid_scalar(lo, hi, j: int):
+    """The j-th of T candidate thresholds strictly inside (lo, hi) — the
+    scalar form of :func:`_mids`, same spacing so the global bisection
+    converges on the same schedule."""
+    r1 = hi - lo - 1
+    q = r1 // _BATCHTOPK_T
+    rem = r1 - q * _BATCHTOPK_T
+    return lo + 1 + q * j + (rem * j) // _BATCHTOPK_T
+
+
+def _batchtopk_bisect_kernel(h_ref, kth_ref, lo_s, hi_s, cnt_s, *,
+                             kk: int, shift: int, hi_init: int,
+                             n_passes: int, n_rb: int, n_chunks: int):
+    """Grid ``(n_passes, row_blocks, chunks)``, all sequential: accumulate
+    GLOBAL ``count(bits >= mid_j)`` for T thresholds across every tile of
+    the batch (SMEM scalar accumulators), narrow [lo, hi) at each pass
+    boundary. Output (final pass): the exact (k·B)-th largest shifted
+    pattern. Zero-padded rows are invisible to the count — every candidate
+    threshold is >= lo+1 >= 1, above the zero pattern."""
+    p = pl.program_id(0)
+    r = pl.program_id(1)
+    c = pl.program_id(2)
+
+    @pl.when((p == 0) & (r == 0) & (c == 0))
+    def _init():
+        lo_s[0] = 0
+        hi_s[0] = hi_init
+
+    @pl.when((r == 0) & (c == 0))
+    def _reset_counts():
+        for j in range(_BATCHTOPK_T):
+            cnt_s[j] = 0
+
+    bits = _row_bits(h_ref, shift)
+    lo = lo_s[0]
+    hi = hi_s[0]
+    for j in range(_BATCHTOPK_T):
+        mid_j = _mid_scalar(lo, hi, j)
+        cnt_s[j] = cnt_s[j] + jnp.sum((bits >= mid_j).astype(jnp.int32))
+
+    @pl.when((r == n_rb - 1) & (c == n_chunks - 1))
+    def _finish_pass():
+        # counts are non-increasing in j (mids ascend), so (cnt >= kk) is
+        # prefix-true; j* = num_ge - 1 is the largest threshold still above
+        # >= kk entries — the same narrowing rule as _bisect_kernel, in
+        # scalar form (unrolled where-chain over the T candidates)
+        num_ge = jnp.int32(0)
+        for j in range(_BATCHTOPK_T):
+            num_ge = num_ge + (cnt_s[j] >= kk).astype(jnp.int32)
+        new_lo = lo
+        new_hi = hi
+        for j in range(_BATCHTOPK_T):
+            mid_j = _mid_scalar(lo, hi, j)
+            new_lo = jnp.where(num_ge == j + 1, mid_j, new_lo)
+            new_hi = jnp.where(num_ge == j, mid_j, new_hi)
+        lo_s[0] = new_lo
+        hi_s[0] = new_hi
+
+        @pl.when(p == n_passes - 1)
+        def _emit_result():
+            kth_ref[0, 0] = new_lo
+
+
+def _batchtopk_emit_kernel(h_ref, kth_ref, out_ref, *, shift: int):
+    """Grid ``(row_blocks, chunks)``: apply the global threshold mask.
+    BatchTopK keeps ALL entries tied at the threshold (``>=``), so there
+    is no tie quota to carry — one guard-free sweep."""
+    hp = jnp.maximum(h_ref[:].astype(jnp.float32), 0.0)
+    bits = jax.lax.bitcast_convert_type(hp, jnp.int32)
+    if shift:
+        bits = jax.lax.shift_right_logical(bits, shift)
+    kth = kth_ref[0, 0]
+    # (bits > 0) mirrors the dense mask's (hp > 0) — pattern order-
+    # isomorphism for non-negative floats, and it zeroes the padded rows
+    keep = (bits >= kth) & (bits > 0)
+    out_ref[:] = jnp.where(keep, hp, 0.0).astype(out_ref.dtype)
+
+
+def _batchtopk_geometry(flat: jax.Array):
+    width = flat.shape[-1]
+    cw = _CHUNK_WIDTH if width % _CHUNK_WIDTH == 0 else width
+    n_chunks = width // cw
+    n_rows = flat.shape[0]
+    rows = min(_CHUNK_ROWS, -(-n_rows // 32) * 32)
+    pad = (-n_rows) % rows
+    if pad:
+        flat = jnp.pad(flat, ((0, pad), (0, 0)))
+    return flat, cw, n_chunks, rows, pad
+
+
+def _batchtopk_mask_impl(h: jax.Array, thresh_pattern: jax.Array,
+                         interpret: bool) -> jax.Array:
+    """Emit pass only: mask ``h`` against a shifted-pattern threshold."""
+    lead = h.shape[:-1]
+    width = h.shape[-1]
+    shift, _ = _shift_and_range(h.dtype)
+    flat = h.reshape(-1, width)
+    n_rows = flat.shape[0]
+    flat, cw, n_chunks, rows, pad = _batchtopk_geometry(flat)
+    emit_params = None
+    if not interpret:
+        emit_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        )
+    out = pl.pallas_call(
+        functools.partial(_batchtopk_emit_kernel, shift=shift),
+        out_shape=jax.ShapeDtypeStruct(flat.shape, h.dtype),
+        grid=(flat.shape[0] // rows, n_chunks),
+        in_specs=[
+            pl.BlockSpec((rows, cw), lambda i, c: (i, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, c: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, cw), lambda i, c: (i, c),
+                               memory_space=pltpu.VMEM),
+        compiler_params=emit_params,
+        interpret=interpret,
+    )(flat, thresh_pattern)
+    if pad:
+        out = out[:n_rows]
+    return out.reshape(*lead, width)
+
+
+def _batchtopk_fwd_impl(h: jax.Array, k: int, interpret: bool) -> jax.Array:
+    width = h.shape[-1]
+    flat = h.reshape(-1, width)
+    n_rows = flat.shape[0]
+    kk = min(k * n_rows, flat.size)          # un-padded count: parity with
+    shift, hi_init = _shift_and_range(h.dtype)  # batchtopk_threshold_of
+    n_passes = _n_bisect_passes(hi_init, _BATCHTOPK_T)
+    flat_p, cw, n_chunks, rows, _ = _batchtopk_geometry(flat)
+    n_rb = flat_p.shape[0] // rows
+
+    compiler_params = None
+    if not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")
+        )
+    kth = pl.pallas_call(
+        functools.partial(
+            _batchtopk_bisect_kernel, kk=kk, shift=shift, hi_init=hi_init,
+            n_passes=n_passes, n_rb=n_rb, n_chunks=n_chunks,
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        grid=(n_passes, n_rb, n_chunks),
+        in_specs=[
+            pl.BlockSpec((rows, cw), lambda p, i, c: (i, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda p, i, c: (0, 0),
+                               memory_space=pltpu.SMEM),
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.int32),               # lo
+            pltpu.SMEM((1,), jnp.int32),               # hi
+            pltpu.SMEM((_BATCHTOPK_T,), jnp.int32),    # global counts
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(flat_p)
+    return _batchtopk_mask_impl(h, kth, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def batchtopk(h: jax.Array, k: int, interpret: bool = False) -> jax.Array:
+    """Global-threshold BatchTopK mask of the ReLU'd pre-acts, keeping the
+    k·batch largest entries (ALL ties at the threshold kept — the
+    activations.batchtopk contract). Bit-identical to the dense oracle."""
+    return _batchtopk_fwd_impl(h, k, interpret or _INTERPRET)
+
+
+def _batchtopk_vjp_fwd(h, k, interpret):
+    out = _batchtopk_fwd_impl(h, k, interpret or _INTERPRET)
+    return out, out
+
+
+def _batchtopk_vjp_bwd(k, interpret, out, g):
+    # straight-through on the survivors — the dense path's
+    # hp·stop_grad(mask) gradient (mask implies hp > 0, so out > 0 is
+    # exactly the mask)
+    return (jnp.where(out > 0, g, 0).astype(g.dtype),)
+
+
+batchtopk.defvjp(_batchtopk_vjp_fwd, _batchtopk_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def batchtopk_fixed(h: jax.Array, threshold: float,
+                    interpret: bool = False) -> jax.Array:
+    """Fixed-threshold BatchTopK (eval mode): the emit sweep alone, with
+    the calibrated threshold's shifted bit pattern computed at trace time
+    (the cast through ``h.dtype`` mirrors activations.batchtopk_fixed's
+    compare dtype exactly). A threshold <= 0 clamps to the zero pattern:
+    the dense mask ``(hp >= thresh) & (hp > 0)`` degenerates to
+    ``hp > 0`` there, and a sign-set pattern must never reach the
+    shifted unsigned compare (it would order above every finite
+    value, masking everything)."""
+    shift, _ = _shift_and_range(h.dtype)
+    tval = jnp.asarray(threshold, h.dtype).astype(jnp.float32)
+    # sign-set patterns (negative threshold, -0.0) clamp to the zero
+    # pattern at the INT level — exact, unlike a float max against -0.0
+    tpat = jnp.maximum(jax.lax.bitcast_convert_type(tval, jnp.int32), 0)
+    if shift:
+        tpat = jax.lax.shift_right_logical(tpat, shift)
+    return _batchtopk_mask_impl(h, tpat.reshape(1, 1),
+                                interpret or _INTERPRET)
+
+
+def _batchtopk_fixed_vjp_fwd(h, threshold, interpret):
+    out = batchtopk_fixed(h, threshold, interpret)
+    return out, out
+
+
+def _batchtopk_fixed_vjp_bwd(threshold, interpret, out, g):
+    return (jnp.where(out > 0, g, 0).astype(g.dtype),)
+
+
+batchtopk_fixed.defvjp(_batchtopk_fixed_vjp_fwd, _batchtopk_fixed_vjp_bwd)

@@ -75,6 +75,17 @@ def make_train_step(
     exchanged quantized with error feedback; optimizer, clipping, and
     schedules run outside on the (near-exact) mean gradient, so the step's
     update math is otherwise identical.
+
+    ``cfg.sparse_bwd`` (the scatter-accumulate backward plane,
+    docs/SCALING.md "Sparse backward plane") needs no key of its own in
+    the compiled-variant cache: its tier SCOPE rides the ``aux_on`` pair
+    already keyed here. ``aux_on=False`` steps pass no dead_mask, so
+    :func:`crosscoder_tpu.models.crosscoder.get_losses` traces the
+    full-step sparse variant (encode+decode in one custom vjp — zero
+    dense backward matmuls); ``aux_on=True`` steps need the pre-acts for
+    the AuxK ranking and trace the (h, W_dec)-scoped variant. Both are
+    static trace-time decisions off (cfg, batch shape), so each cached
+    variant is internally consistent.
     """
     if cfg.batchtopk_threshold > 0:
         # the frozen threshold is EVAL-only (calibrate_batchtopk_threshold):
@@ -359,11 +370,29 @@ class Trainer:
         )
         self._state_shardings = mesh_lib.state_shardings(self.mesh, state, cfg.shard_sources)
         self.state = jax.device_put(state, self._state_shardings)
+        # the sparse backward plane's dispatch is static per cfg/batch —
+        # announce it once so runs record WHICH backward they measured
+        # (cfg.sparse_bwd="auto" silently stays dense off-TPU / without
+        # the kernel opt-in env), and flag the forced-"on" XLA-scatter
+        # fallback: sound, but it is the measured-slow path the kernel
+        # exists to beat
+        if cc.use_sparse_bwd(cfg, cfg.batch_size):
+            from crosscoder_tpu.ops import sparse_grad
+
+            kind = ("pallas scatter-accumulate" if sparse_grad.kernel_enabled()
+                    and sparse_grad.decode_grad_supported(
+                        cfg.dict_size, cfg.topk_k, cfg.n_sources, cfg.d_in,
+                        cfg.batch_size)
+                    else "XLA scatter fallback (forced; expect the dense "
+                         "backward to be faster)")
+            print(f"[crosscoder_tpu] sparse backward plane active: {kind}",
+                  flush=True)
         # compiled step variants, keyed (with_metrics, aux_on, mask_refresh);
         # built lazily except the default. aux_on alternates per
         # cfg.aux_every (AuxK amortization), mask_refresh per
         # cfg.aux_mask_cadence (cached dead masks); the host-side step
-        # mirror picks the variant without a device sync.
+        # mirror picks the variant without a device sync. cfg.sparse_bwd
+        # adds no key: its tier scope follows aux_on (see make_train_step).
         self._step_fns: dict[tuple[bool, bool, bool], Callable] = {
             (True, True, True): make_train_step(cfg, self.mesh, tx, self._state_shardings)
         }

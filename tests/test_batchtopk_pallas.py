@@ -1,0 +1,147 @@
+"""BatchTopK through the chunked Pallas global-threshold kernels
+(ops/topk_pallas.batchtopk / batchtopk_fixed, interpret mode on CPU):
+bit-identical masks vs the dense oracle (activations.batchtopk with the
+kernel forced off) — including ties at the threshold, which BatchTopK
+keeps in full — plus the straight-through gradient, the supported-shape
+gate, and the activations-layer dispatch (kernel when live+supported,
+dense fallback otherwise). All CPU, tier-1."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from crosscoder_tpu.ops import activations as act
+from crosscoder_tpu.ops import topk_pallas
+
+
+@pytest.fixture(autouse=True)
+def _interpret_kernels():
+    """Run every Pallas dispatch through the interpreter (the CPU
+    stand-in for the TPU kernel, same as test_topk_pallas / test_quant);
+    also flips batchtopk_kernel_enabled() on for the dispatch tests."""
+    topk_pallas.set_interpret(True)
+    yield
+    topk_pallas.set_interpret(False)
+
+
+def _dense(h, k):
+    return np.asarray(act.batchtopk(h, k, use_pallas=False))
+
+
+# width cases: chunk-divisible multi-chunk (2 x _CHUNK_WIDTH), a single
+# non-chunk-divisible VMEM-sized chunk, and the lane-aligned minimum;
+# batch cases include a non-multiple-of-32 row count (the geometry's
+# zero-padded tail rows must stay invisible to the global count)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("B,width,k", [
+    (16, 8192, 4),     # 2 chunks of _CHUNK_WIDTH
+    (5, 640, 3),       # single chunk, width % _CHUNK_WIDTH != 0, row pad
+    (33, 256, 2),      # minimum width, row pad
+])
+def test_batchtopk_matches_dense_oracle(B, width, k, dtype):
+    h = jax.random.normal(jax.random.key(B * width + k), (B, width), dtype)
+    out = topk_pallas.batchtopk(h, k, True)
+    assert out.dtype == h.dtype
+    np.testing.assert_array_equal(np.asarray(out), _dense(h, k))
+
+
+def test_batchtopk_keeps_all_ties_at_threshold():
+    # plant more copies of the threshold value than the budget has room
+    # for: BatchTopK's contract keeps every tie (mask is >=, no tie quota)
+    h = np.full((4, 256), -1.0, np.float32)
+    h[0, :7] = 2.0          # 7 entries above ...
+    h[1, :6] = 1.0          # ... 6 tied AT the k*B=8-th largest
+    out = np.asarray(topk_pallas.batchtopk(jnp.asarray(h), 2, True))
+    assert int((out > 0).sum()) == 13
+    np.testing.assert_array_equal(out, _dense(jnp.asarray(h), 2))
+
+
+def test_batchtopk_all_zero_and_full_budget():
+    z = jnp.zeros((4, 256), jnp.float32)
+    assert int((np.asarray(topk_pallas.batchtopk(z, 3, True)) > 0).sum()) == 0
+    # budget >= positive count: every positive entry survives
+    h = jax.random.normal(jax.random.key(0), (4, 256), jnp.float32)
+    out = np.asarray(topk_pallas.batchtopk(h, 256, True))
+    np.testing.assert_array_equal(out > 0, np.asarray(h) > 0)
+    np.testing.assert_array_equal(out, _dense(h, 256))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_batchtopk_fixed_matches_dense(dtype):
+    h = jax.random.normal(jax.random.key(7), (6, 640), dtype)
+    # <= 0 thresholds degenerate to the hp > 0 mask in the dense path; the
+    # kernel must clamp the sign-set pattern rather than unsigned-compare it
+    for threshold in (0.5, 1.25, 0.0, -0.5, -0.0):
+        out = topk_pallas.batchtopk_fixed(h, threshold, True)
+        expect = np.asarray(act.batchtopk_fixed(h, threshold,
+                                                use_pallas=False))
+        np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_batchtopk_gradient_matches_dense():
+    # straight-through on the survivors, exactly the dense mask's
+    # hp * stop_grad(mask) gradient
+    h = jax.random.normal(jax.random.key(3), (8, 512), jnp.float32)
+    g_pallas = jax.grad(lambda x: topk_pallas.batchtopk(x, 4, True).sum())(h)
+    g_dense = jax.grad(
+        lambda x: act.batchtopk(x, 4, use_pallas=False).sum()
+    )(h)
+    np.testing.assert_array_equal(np.asarray(g_pallas), np.asarray(g_dense))
+    gf_pallas = jax.grad(
+        lambda x: topk_pallas.batchtopk_fixed(x, 0.5, True).sum()
+    )(h)
+    gf_dense = jax.grad(
+        lambda x: act.batchtopk_fixed(x, 0.5, use_pallas=False).sum()
+    )(h)
+    np.testing.assert_array_equal(np.asarray(gf_pallas), np.asarray(gf_dense))
+
+
+def test_batchtopk_supported_gates():
+    ok = jnp.zeros((4, 8192), jnp.bfloat16)
+    assert topk_pallas.batchtopk_supported(ok, 32)
+    assert topk_pallas.batchtopk_supported(jnp.zeros((4, 640)), 4)
+    assert not topk_pallas.batchtopk_supported(jnp.zeros((4, 100)), 4)   # lanes
+    assert not topk_pallas.batchtopk_supported(jnp.zeros((4, 128)), 4)   # < 256
+    assert not topk_pallas.batchtopk_supported(jnp.zeros((256,)), 4)     # ndim
+    assert not topk_pallas.batchtopk_supported(ok, 0)                    # k
+    assert not topk_pallas.batchtopk_supported(
+        jnp.zeros((4, 256), jnp.int32), 4)                               # dtype
+    # width neither chunk-divisible nor a single VMEM-sized chunk
+    assert not topk_pallas.batchtopk_supported(jnp.zeros((4, 8192 + 128)), 4)
+
+
+def test_activations_dispatch_routes_to_kernel(monkeypatch):
+    # interpret mode makes batchtopk_kernel_enabled() true; a supported
+    # shape with use_pallas=True must take the kernel path
+    assert topk_pallas.batchtopk_kernel_enabled()
+    calls = []
+    real = topk_pallas.batchtopk
+    monkeypatch.setattr(topk_pallas, "batchtopk",
+                        lambda h, k, interpret=False:
+                        calls.append("kernel") or real(h, k, interpret))
+    h = jax.random.normal(jax.random.key(1), (4, 512), jnp.float32)
+    out = act.batchtopk(h, 4, use_pallas=True)
+    assert calls == ["kernel"]
+    np.testing.assert_array_equal(np.asarray(out), _dense(h, 4))
+
+
+def test_activations_dispatch_dense_fallback_unsupported(monkeypatch):
+    # unsupported width (not lane-aligned) silently falls back dense —
+    # the kernel must never be entered
+    def _boom(*a, **kw):
+        raise AssertionError("kernel entered on unsupported shape")
+
+    monkeypatch.setattr(topk_pallas, "batchtopk", _boom)
+    h = jax.random.normal(jax.random.key(2), (4, 100), jnp.float32)
+    out = act.batchtopk(h, 4, use_pallas=True)
+    np.testing.assert_array_equal(np.asarray(out), _dense(h, 4))
+
+
+def test_kernel_gated_off_without_optin(monkeypatch):
+    # off interpret mode + CPU backend: the hardware gate holds even if
+    # the env var is set (the quant.py precedent — TPU-only opt-in)
+    topk_pallas.set_interpret(False)
+    monkeypatch.setenv("CROSSCODER_BATCHTOPK_PALLAS", "1")
+    assert not topk_pallas.batchtopk_kernel_enabled()
