@@ -1,6 +1,6 @@
 """Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Six sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
+Seven sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
 exactly ONE machine-parseable JSON line on stdout, guaranteed last —
 stray prints are rerouted to stderr for the whole run):
 
@@ -23,6 +23,10 @@ stray prints are rerouted to stderr for the whole run):
   the full model — weights are random because this environment is
   air-gapped, which changes no matmul shapes). Reports steady-state
   acts/sec and the refresh-bubble profile (max vs median step).
+- **harvest**: the LM-harvest side (the dominant per-step cost outside
+  the crosscoder) on a mixed-length synthetic corpus: padded-vs-paged
+  runtime A/B — tokens/s over REAL tokens, padding-efficiency %, and the
+  paged speedup (docs/SCALING.md "Harvest cost model").
 - **quant**: the int8 data-plane quality gates (docs/SCALING.md
   "Quantized data plane"): roundtrip per-row MSE on a Gemma-shaped
   heavy-tailed probe, store-byte ratio, and the quantized grad
@@ -632,6 +636,89 @@ def section_e2e() -> dict:
     return out
 
 
+def section_harvest() -> dict:
+    """The LM-harvest side on a mixed-length synthetic corpus — the
+    dominant per-step cost outside the crosscoder, invisible in every
+    BENCH_*.json before this section. A/B of the padded forward
+    (run_with_cache_multi: every document padded to seq_len) vs the paged
+    runtime (run_with_cache_multi_paged: documents packed into a dense
+    token plane, per-document ragged attention — docs/SCALING.md "Harvest
+    cost model"). Tokens/s counts REAL tokens for both arms, so the
+    speedup is exactly the padding waste reclaimed."""
+    import numpy as np
+
+    from crosscoder_tpu.data import paging
+    from crosscoder_tpu.models import lm
+
+    tiny = os.environ.get("BENCH_TINY") == "1"    # CI/debug only
+    if tiny:
+        lm_cfg = lm.LMConfig.tiny()
+        S, n_docs, reps, page = 16, 16, 2, 8
+        hook = f"blocks.{lm_cfg.n_layers}.hook_resid_pre"
+    else:
+        # mid shape in the production FLOP regime — attention ~4% of the
+        # per-token cost (Gemma-2-2B at seq 1024 is ~5%), matmuls dominate
+        # — small enough that the CPU fallback finishes in seconds
+        lm_cfg = lm.LMConfig(
+            vocab_size=1024, d_model=384, n_layers=4, n_heads=6,
+            n_kv_heads=2, head_dim=64, d_ff=1536, sliding_window=64,
+            query_pre_attn_scalar=64.0, dtype="fp32",
+        )
+        S = int(os.environ.get("BENCH_HARVEST_SEQ", 128))
+        n_docs = int(os.environ.get("BENCH_HARVEST_DOCS", 32))
+        reps = int(os.environ.get("BENCH_HARVEST_STEPS", 4))
+        page = 32
+        hook = f"blocks.{lm_cfg.n_layers}.hook_resid_pre"
+    params = [lm.init_params(jax.random.key(i), lm_cfg) for i in (0, 1)]
+    rng = np.random.default_rng(5)
+    # chat-shaped mixed-length corpus (most documents well under seq_len,
+    # a few at it — the LmSys half of the production mix): ~40% padding
+    # efficiency, inside the acceptance criterion's <= 60% regime;
+    # single-token and max-length docs included
+    lengths = rng.integers(max(1, S // 16), max(2, (5 * S) // 8), size=n_docs)
+    lengths[0], lengths[1] = 1, S
+    tokens = rng.integers(1, lm_cfg.vocab_size, size=(n_docs, S), dtype=np.int64)
+    for d, ln in enumerate(lengths):
+        tokens[d, ln:] = 0
+    hooks = (hook,)
+    eff = paging.padding_efficiency(lengths, S)
+
+    def run_padded():
+        return lm.run_with_cache_multi(params, jnp.asarray(tokens), lm_cfg, hooks)
+
+    def run_paged():
+        # packing runs per call — the host-side cost is part of the runtime
+        return lm.run_with_cache_multi_paged(
+            params, tokens, lengths, lm_cfg, hooks, page_size=page,
+        )
+
+    times = {}
+    for name, fn in (("padded", run_padded), ("paged", run_paged)):
+        jax.block_until_ready(fn())                   # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = fn()
+        jax.block_until_ready(r)
+        times[name] = (time.perf_counter() - t0) / reps
+    real_tokens = int(lengths.sum())
+    out = {
+        "padding_efficiency": round(eff, 4),
+        "padded_step_ms": round(1000 * times["padded"], 2),
+        "paged_step_ms": round(1000 * times["paged"], 2),
+        "tokens_per_sec_padded": round(real_tokens / times["padded"], 1),
+        "tokens_per_sec_paged": round(real_tokens / times["paged"], 1),
+        "paged_speedup": round(times["padded"] / times["paged"], 3),
+        "page_size": page,
+        "workload": (
+            f"2 models x {n_docs} docs, seq {S}, d_model {lm_cfg.d_model}, "
+            f"{lm_cfg.n_layers} layers, mixed lengths "
+            f"[{int(lengths.min())}, {int(lengths.max())}]"
+        ),
+    }
+    log(f"[harvest] {out}")
+    return out
+
+
 def section_quant() -> dict:
     """The int8 data-plane quality gates (docs/SCALING.md "Quantized data
     plane"), recorded in the bench JSON so every round carries them:
@@ -798,12 +885,13 @@ def _run_sections() -> dict:
     except OSError:
         cache_state = "cold"
     sections = os.environ.get(
-        "BENCH_SECTIONS", "step,matrix,configs,e2e,quant,dash"
+        "BENCH_SECTIONS", "step,matrix,configs,e2e,harvest,quant,dash"
     ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
                      ("configs", section_configs),
-                     ("e2e", section_e2e), ("quant", section_quant),
+                     ("e2e", section_e2e), ("harvest", section_harvest),
+                     ("quant", section_quant),
                      ("dash", section_dash)):
         if name not in sections:
             continue

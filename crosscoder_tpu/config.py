@@ -204,6 +204,28 @@ class CrossCoderConfig:
                                     # one chip; must equal the data-axis size
                                     # and divide seq_len. 0 = batch-sharded
                                     # harvest (default).
+    harvest_runtime: str = "padded"  # LM-harvest forward runtime:
+                                    # "padded" (default — every document
+                                    # padded to seq_len, the reference's
+                                    # layout, byte-identical to builds
+                                    # without this knob) | "paged" — the
+                                    # ragged/paged runtime (data/paging.py
+                                    # + ops/paged_attention.py): mixed-
+                                    # length documents pack into a dense
+                                    # token plane (projections/MLP cost
+                                    # proportional to REAL tokens), with
+                                    # per-document ragged attention over
+                                    # fixed-size KV pages. Bit-identical
+                                    # hook activations to the padded path
+                                    # at valid positions; pad positions
+                                    # are emitted zeroed under an explicit
+                                    # valid-length mask. docs/SCALING.md
+                                    # "Harvest cost model".
+    page_size: int = 64             # paged runtime: tokens per KV page
+                                    # (the attention kernel's DMA/compute
+                                    # quantum). Power of two dividing
+                                    # seq_len; page-table overhead is
+                                    # 4·seq_len/page_size bytes/sequence.
     grad_clip: float = 1.0          # reference hardcodes this (trainer.py:46)
     lr_decay_frac: float = 0.2      # linear lr decay over the last fraction (trainer.py:29-32)
     l1_warmup_frac: float = 0.05    # l1 warmup over the first fraction (trainer.py:36)
@@ -381,6 +403,48 @@ class CrossCoderConfig:
             raise ValueError(
                 f"seq_shards {self.seq_shards} must divide seq_len {self.seq_len}"
             )
+        if self.harvest_runtime not in ("padded", "paged"):
+            import difflib
+
+            close = difflib.get_close_matches(
+                str(self.harvest_runtime), ("padded", "paged"), n=1
+            )
+            hint = f"; did you mean {close[0]!r}?" if close else ""
+            raise ValueError(
+                f"harvest_runtime must be padded|paged, got "
+                f"{self.harvest_runtime!r}{hint}"
+            )
+        if self.page_size < 1 or self.page_size & (self.page_size - 1):
+            below = 1 << max(0, self.page_size.bit_length() - 1)
+            raise ValueError(
+                f"page_size must be a power of two (the KV page is the "
+                f"attention kernel's DMA/compute quantum), got "
+                f"{self.page_size}; try {below} or {2 * below}"
+            )
+        if self.harvest_runtime == "paged":
+            if self.seq_len < self.page_size:
+                raise ValueError(
+                    f"harvest_runtime='paged': seq_len {self.seq_len} is "
+                    f"smaller than page_size {self.page_size} — a document "
+                    f"cannot fill even one KV page; lower page_size to a "
+                    f"power of two <= {self.seq_len}"
+                )
+            if self.seq_len % self.page_size != 0:
+                divisors = [p for p in (16, 32, 64, 128, 256, 512)
+                            if p <= self.seq_len and self.seq_len % p == 0]
+                raise ValueError(
+                    f"harvest_runtime='paged': page_size {self.page_size} "
+                    f"must divide seq_len {self.seq_len} (the KV block "
+                    f"layout is whole pages); try one of "
+                    f"{divisors or 'a power-of-two divisor of seq_len'}"
+                )
+            if self.seq_shards > 1:
+                raise ValueError(
+                    "harvest_runtime='paged' is incompatible with "
+                    "seq_shards: the paged plane packs the sequence axis "
+                    "densely, while the seq-parallel harvest shards it "
+                    "over the mesh — pick one"
+                )
         if self.sparse_decode and self.activation != "topk":
             raise ValueError(
                 f"sparse_decode requires activation='topk', got {self.activation!r}"

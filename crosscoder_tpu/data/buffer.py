@@ -176,6 +176,19 @@ class PairedActivationBuffer:
         if batch_sharding is not None and self._seq_mesh is None:
             data_axis = int(batch_sharding.mesh.shape.get("data", 1))
         self._chunk_seqs = -(-cfg.model_batch_size // data_axis) * data_axis
+        # paged harvest runtime (cfg.harvest_runtime="paged";
+        # models/lm.run_with_cache_multi_paged + data/paging.py): mixed-
+        # length chunks pack into a dense token plane before the forward,
+        # so harvest matmul cost tracks REAL tokens. The emitted chunk
+        # comes back in the padded [C, S, n, d] layout with pad positions
+        # zeroed — every drain/scatter path downstream is untouched, and
+        # on the all-full-length production corpus the stream is BIT-
+        # identical to the padded path (tests/test_paging.py). With the
+        # default "padded" runtime none of this code is reachable.
+        self._paged = cfg.harvest_runtime == "paged"
+        self._plane_multiple = data_axis
+        self._paged_valid_tokens = 0    # padding-efficiency telemetry
+        self._paged_total_tokens = 0
 
         self._alloc_store()
         self._perm = np.arange(self.buffer_size)
@@ -227,6 +240,38 @@ class PairedActivationBuffer:
             token_batch = np.concatenate([token_batch, pad])
         return token_batch, n
 
+    def _harvest_dev_paged(self, padded_tokens: np.ndarray) -> jax.Array:
+        """Paged-runtime harvest of one chunk: ragged lengths from
+        trailing-pad detection, host-side packing, per-document ragged
+        attention — returns the same padded-layout ``[C, S, n, d]`` bf16
+        chunk as the dense path. ``pad_mode="wrap"``: positions past a
+        document's length are filled by cycling its own post-BOS rows, so
+        every row the fixed-rows-per-sequence drain ingests is a REAL
+        activation (short documents' tokens get re-served proportionally
+        more — the packing analogue of the survivor re-serves
+        ``refill_frac`` already makes) rather than a zero vector."""
+        from crosscoder_tpu.data import tokens as tokens_mod
+
+        lengths = tokens_mod.valid_lengths(padded_tokens)
+        self._paged_valid_tokens += int(lengths.sum())
+        self._paged_total_tokens += int(padded_tokens.size)
+        return lm.run_with_cache_multi_paged(
+            self.model_params, padded_tokens, lengths, self.lm_cfg,
+            self.hook_points, page_size=self.cfg.page_size,
+            row_multiple=self._plane_multiple,
+            batch_sharding=self.batch_sharding,
+            pad_mode="wrap", out_dtype=jnp.bfloat16,
+        )
+
+    def padding_efficiency(self) -> float | None:
+        """Real-token fraction of everything harvested so far (paged
+        runtime only; None under the padded runtime — it has no ragged
+        accounting). Logged by the trainer as
+        ``harvest/padding_efficiency``."""
+        if not self._paged or self._paged_total_tokens == 0:
+            return None
+        return self._paged_valid_tokens / self._paged_total_tokens
+
     def _harvest_dev(self, padded_tokens: np.ndarray) -> jax.Array:
         """All sources' hook activations for one fixed-shape token chunk,
         DEVICE-resident ``[C, S, n_sources, d_in]`` bf16 (source axis
@@ -235,6 +280,8 @@ class PairedActivationBuffer:
         No host sync: the result is a future, so callers can pipeline
         several chunks' forwards against host-side fetch/scatter work.
         """
+        if self._paged:
+            return self._harvest_dev_paged(padded_tokens)
         tok = jnp.asarray(padded_tokens)
         if self._seq_mesh is not None:
             # sequence-sharded forwards (ring attention over the data axis),
@@ -401,8 +448,11 @@ class PairedActivationBuffer:
 
     def _segs_per_chunk(self) -> int:
         """Dispatch quanta one harvest chunk costs (pacing denominator)."""
-        if self._seq_mesh is not None:
-            return 1            # seq-parallel harvest stays one dispatch
+        if self._seq_mesh is not None or self._paged:
+            # seq-parallel and paged harvests stay one dispatch each (the
+            # paged plane is one fused jit; its cost already shrank by the
+            # packing factor, which is the bubble the segmentation fights)
+            return 1
         return lm.SegmentedHarvest.count(
             self.lm_cfg, self.hook_points, len(self.model_params)
         )
@@ -412,7 +462,7 @@ class PairedActivationBuffer:
         incremental-refill counterpart of :meth:`_harvest_dev`)."""
         if self.chaos is not None:
             self.chaos.on_harvest()    # injected stall/failure (tests only)
-        if self._seq_mesh is not None:
+        if self._seq_mesh is not None or self._paged:
             return _SingleDispatchJob(self._harvest_dev(padded_tokens))
         tok = jnp.asarray(padded_tokens)
         if self.batch_sharding is not None:

@@ -26,6 +26,76 @@ import numpy as np
 
 from crosscoder_tpu.config import CrossCoderConfig
 
+# Gemma's <pad> token. The paged harvest runtime treats TRAILING pad
+# tokens as absent (ragged document lengths); Gemma tokenizers never emit
+# id 0 inside real text, so trailing-pad detection cannot trim content.
+PAD_ID = 0
+
+
+def valid_lengths(tokens: np.ndarray, pad_id: int = PAD_ID) -> np.ndarray:
+    """Per-row document length: tokens up to (and including) the last
+    non-pad position. A row of pure padding counts as length 1 (the BOS
+    slot) so every document stays a valid attention target.
+
+    This is the ragged-length source for ``cfg.harvest_runtime="paged"``:
+    the production corpus is pre-chunked full-length (no pads → every
+    length equals ``seq_len``, and the paged runtime packs to the identity
+    layout), while ragged corpora right-pad with ``pad_id``.
+    """
+    tokens = np.asarray(tokens)
+    nz = tokens != pad_id
+    lengths = tokens.shape[1] - np.argmax(nz[:, ::-1], axis=1)
+    return np.where(nz.any(axis=1), lengths, 1).astype(np.int32)
+
+
+def length_stats(
+    tokens_or_lengths: np.ndarray,
+    seq_len: int | None = None,
+    n_buckets: int = 8,
+    pad_id: int = PAD_ID,
+    sample_rows: int = 4096,
+) -> dict:
+    """Document-length distribution of a corpus (sampled): histogram
+    buckets, mean/median length, and the padding-efficiency estimate that
+    predicts the paged runtime's win (~1/efficiency on the projections/
+    MLP cost) BEFORE a run commits to it.
+
+    Accepts a 2-D token matrix (lengths derived via :func:`valid_lengths`
+    on ``sample_rows`` rows strided EVENLY across the corpus — a head
+    sample would mislead on ordered corpora, e.g. full-length pile rows
+    concatenated before ragged chat rows; still cheap on an mmap'd
+    400M-token corpus) or a precomputed 1-D length array (then
+    ``seq_len`` is required).
+    """
+    arr = np.asarray(tokens_or_lengths)
+    # ceil division: floor would head-sample any corpus with
+    # sample_rows < n_rows < 2*sample_rows (stride 1)
+    stride = max(1, -(-arr.shape[0] // sample_rows))
+    if arr.ndim == 2:
+        seq_len = arr.shape[1]
+        lengths = valid_lengths(np.asarray(arr[::stride][:sample_rows]), pad_id)
+    else:
+        if seq_len is None:
+            raise ValueError("seq_len is required with precomputed lengths")
+        lengths = arr[::stride][:sample_rows].astype(np.int64)
+    if lengths.size == 0:
+        raise ValueError("empty corpus")
+    edges = np.linspace(0, seq_len, n_buckets + 1)
+    hist, _ = np.histogram(lengths, bins=edges)
+    eff = float(lengths.sum() / (lengths.size * seq_len))
+    return {
+        "n_sampled": int(lengths.size),
+        "seq_len": int(seq_len),
+        "mean_len": round(float(lengths.mean()), 1),
+        "median_len": int(np.median(lengths)),
+        "min_len": int(lengths.min()),
+        "max_len": int(lengths.max()),
+        "bucket_edges": [int(e) for e in edges],
+        "bucket_counts": [int(c) for c in hist],
+        "padding_efficiency": round(eff, 4),
+        "paged_matmul_speedup_estimate": round(1.0 / max(eff, 1e-9), 2),
+    }
+
 
 def rechunk(tokens: np.ndarray, seq_len: int) -> np.ndarray:
     """Reshape a pretokenized ``[n, w]`` corpus to width ``seq_len``.
@@ -58,6 +128,19 @@ def rechunk(tokens: np.ndarray, seq_len: int) -> np.ndarray:
     )
 
 
+def _emit_length_stats(tokens: np.ndarray) -> np.ndarray:
+    """One-line sampled length-distribution summary (the paged runtime's
+    expected win, predictable before a run — see :func:`length_stats`)."""
+    s = length_stats(tokens)
+    print(
+        f"[crosscoder_tpu] corpus lengths (n={s['n_sampled']} sampled): "
+        f"mean {s['mean_len']}/{s['seq_len']}, padding efficiency "
+        f"{s['padding_efficiency']:.2%} → paged matmul speedup ~"
+        f"{s['paged_matmul_speedup_estimate']}x"
+    )
+    return tokens
+
+
 def load_pile_lmsys_mixed_tokens(
     cfg: CrossCoderConfig, mmap: bool = True
 ) -> np.ndarray:
@@ -67,14 +150,19 @@ def load_pile_lmsys_mixed_tokens(
     data_dir = Path(cfg.data_dir)
     npy = data_dir / f"{name}.npy"
     if npy.exists():
-        return rechunk(np.load(npy, mmap_mode="r" if mmap else None), cfg.seq_len)
+        return _emit_length_stats(
+            rechunk(np.load(npy, mmap_mode="r" if mmap else None), cfg.seq_len)
+        )
 
     pt = data_dir / f"{name}.pt"
     if pt.exists():
         import torch  # the reference's cache format (utils.py:186)
 
         tokens = torch.load(pt, map_location="cpu").numpy()
-        return rechunk(np.ascontiguousarray(tokens.astype(np.int32, copy=False)), cfg.seq_len)
+        return _emit_length_stats(rechunk(
+            np.ascontiguousarray(tokens.astype(np.int32, copy=False)),
+            cfg.seq_len,
+        ))
 
     print(f"[crosscoder_tpu] downloading {cfg.dataset_name} (first run only)")
     import datasets  # deferred: network path
@@ -85,4 +173,4 @@ def load_pile_lmsys_mixed_tokens(
     data_dir.mkdir(parents=True, exist_ok=True)
     np.save(npy, tokens)
     print(f"[crosscoder_tpu] cached {tokens.shape} tokens at {npy}")
-    return rechunk(tokens, cfg.seq_len)
+    return _emit_length_stats(rechunk(tokens, cfg.seq_len))

@@ -194,14 +194,15 @@ def _softcap(x: jax.Array, cap: float) -> jax.Array:
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotate pairs (x[..., :d/2], x[..., d/2:]) — HF 'split-half' layout.
 
-    x: [B, S, n_heads, head_dim]; positions: [S].
+    x: [B, S, n_heads, head_dim]; positions: [S] (shared across the batch,
+    the padded path) or [B, S] (per-token — the paged runtime's packed
+    plane carries each document's own within-document positions).
     """
     d = x.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d // 2, dtype=jnp.float32) * 2.0 / d))
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]          # [S, d/2]
-    cos, sin = jnp.cos(ang), jnp.sin(ang)
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freqs   # [(B,) S, d/2]
+    cos = jnp.expand_dims(jnp.cos(ang), -2)                  # [(B,) S, 1, d/2]
+    sin = jnp.expand_dims(jnp.sin(ang), -2)
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
     return jnp.concatenate(
@@ -224,32 +225,40 @@ def _qkv(
     return q, k, v.astype(x.dtype).reshape(B, S, KV, hd)
 
 
+def _attn_core(
+    q: jax.Array, k: jax.Array, v: jax.Array, cfg: LMConfig,
+    is_local: jax.Array, lengths: jax.Array | None = None,
+) -> jax.Array:
+    """Masked-softmax attention on projected heads: q [B, S, H, hd],
+    k/v [B, S, KV, hd] → [B, S, H·hd] (pre output-projection).
+
+    Delegates to the ONE attention-math implementation
+    (:func:`crosscoder_tpu.ops.paged_attention.ragged_attention_reference`)
+    with cfg-derived scalars, so the padded forward and the paged
+    runtime's XLA path / kernel oracle can never drift apart. ``lengths``
+    (the paged runtime's per-document valid token counts) adds a key-side
+    validity mask — a no-op for valid queries (causal ⊆ in-length), which
+    is what makes the paged XLA path bit-identical to the padded forward
+    at valid positions (rows at t >= length are computed on whatever the
+    gather clamped to, and discarded)."""
+    from crosscoder_tpu.ops import paged_attention as pa
+
+    return pa.ragged_attention_reference(
+        q, k, v, lengths,
+        scale=cfg.query_pre_attn_scalar ** -0.5,
+        softcap=cfg.attn_softcap, window=cfg.sliding_window,
+        is_local=is_local,
+    )
+
+
 def _attention(
     x: jax.Array, lp: Mapping[str, jax.Array], cfg: LMConfig, is_local: jax.Array
 ) -> jax.Array:
     """One attention sublayer on [B, S, D]. ``is_local`` selects the
     sliding-window mask (traced scalar — both masks are static precomputes)."""
     B, S, D = x.shape
-    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    pos = jnp.arange(S)
-    q, k, v = _qkv(x, lp, cfg, pos)
-
-    # GQA: fold the group axis into the query head axis instead of repeating
-    # K/V (saves HBM traffic; XLA contracts over the shared kv head axis).
-    g = H // KV
-    q = q.reshape(B, S, KV, g, hd) * (cfg.query_pre_attn_scalar ** -0.5)
-    logits = jnp.einsum("bqkgh,bskh->bkgqs", q, k, preferred_element_type=jnp.float32)
-    if cfg.attn_softcap:
-        logits = _softcap(logits, cfg.attn_softcap)
-
-    causal = pos[:, None] >= pos[None, :]                                   # [S, S]
-    window = pos[:, None] - pos[None, :] < cfg.sliding_window
-    mask = jnp.where(is_local, causal & window, causal)
-    logits = jnp.where(mask[None, None, None], logits, -2.3819763e38)
-    probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-
-    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, v, preferred_element_type=jnp.float32)
-    out = out.astype(x.dtype).reshape(B, S, H * hd)
+    q, k, v = _qkv(x, lp, cfg, jnp.arange(S))
+    out = _attn_core(q, k, v, cfg, is_local)
     return jnp.einsum("bsq,qd->bsd", out, lp["wo"], preferred_element_type=jnp.float32).astype(x.dtype)
 
 
@@ -772,6 +781,210 @@ class SegmentedHarvest:
         while self._out is None:
             self.step()
         return self._out
+
+
+# ---------------------------------------------------------------------------
+# paged/ragged harvest (continuous batching; cfg.harvest_runtime="paged")
+
+
+def _paged_capture_one(
+    params: LMParams,
+    plane_tokens: jax.Array,      # [R, Sp] packed token plane
+    pos2d: jax.Array,             # [R, Sp] within-document positions
+    doc_idx: jax.Array,           # [D, S] flat plane index per document token
+    plane_idx: jax.Array,         # [R, Sp] flat doc*S+t index per plane slot
+    lengths: jax.Array,           # [D]
+    cfg: LMConfig,
+    capture: tuple[tuple[int, int], ...],
+    n_scan: int,
+    page_size: int,
+    use_kernel: bool,
+) -> jax.Array:
+    """One model's capture forward over the PACKED token plane.
+
+    Every position-local op (embedding, norms, Q/K/V/output projections,
+    MLP, capture FMAs — ~93% of harvest FLOPs at Gemma-2-2B shapes) runs
+    on the dense ``[R, Sp]`` plane, so its cost is proportional to real
+    tokens. Attention runs per DOCUMENT: heads are gathered through
+    ``doc_idx`` into per-document padded buffers, attended with the ragged
+    length mask (XLA path — bit-identical to the padded forward at valid
+    positions) or the ragged-paged-attention kernel
+    (:mod:`crosscoder_tpu.ops.paged_attention`, page loop bounded by
+    ``ceil(len/page_size)``), and scattered back through ``plane_idx``.
+    Returns the capture buffer ``[n_cap, R, Sp, d_model]`` (still packed;
+    the caller unpacks per document). Unused plane positions carry
+    finite garbage (pad-token forwards) that no document ever gathers.
+    """
+    from crosscoder_tpu.ops import paged_attention as pa
+
+    R, Sp = plane_tokens.shape
+    D, S = doc_idx.shape
+    dt = dtype_of(cfg.dtype)
+    n_cap = len(capture)
+    cap_arr = jnp.asarray([l for l, _ in capture], jnp.int32) if n_cap else None
+    cap_sites = jnp.asarray([c for _, c in capture], jnp.int32) if n_cap else None
+    want_attn = any(c == _SITE_ATTN for _, c in capture)
+    want_mlp = any(c == _SITE_MLP for _, c in capture)
+
+    resid = params["embed"][plane_tokens].astype(dt) * jnp.asarray(
+        math.sqrt(cfg.d_model), dt
+    )
+    buf = jnp.zeros((n_cap, R, Sp, cfg.d_model), dt)
+
+    def gather_docs(x):          # [R, Sp, ...] -> [D, S, ...]
+        return x.reshape((R * Sp,) + x.shape[2:])[doc_idx]
+
+    def scatter_plane(x):        # [D, S, ...] -> [R, Sp, ...]
+        return x.reshape((D * S,) + x.shape[2:])[plane_idx]
+
+    def attn_docs(qd, kd, vd, is_local):
+        if not use_kernel:
+            return _attn_core(qd, kd, vd, cfg, is_local, lengths=lengths)
+        # the kernel bakes the window statically; the traced layer parity
+        # selects between the two compiled instances
+        def run(window):
+            def fn(args):
+                return pa.paged_attention(
+                    *args, lengths, page_size=page_size,
+                    scale=cfg.query_pre_attn_scalar ** -0.5,
+                    softcap=cfg.attn_softcap, window=window,
+                )
+            return fn
+        return jax.lax.cond(
+            is_local, run(cfg.sliding_window), run(0), (qd, kd, vd)
+        )
+
+    def body(carry, xs):
+        resid, buf = carry
+        lp, i = xs
+        buf = _capture_into(buf, resid, i, cap_arr, _SITE_RESID, cap_sites)
+        is_local = (i % 2) == 0
+        xn = _rms_norm(resid, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _qkv(xn, lp, cfg, pos2d)
+        a_docs = attn_docs(gather_docs(q), gather_docs(k), gather_docs(v),
+                           is_local)
+        a = scatter_plane(a_docs)
+        a = jnp.einsum(
+            "bsq,qd->bsd", a, lp["wo"], preferred_element_type=jnp.float32
+        ).astype(dt)
+        attn_out = _rms_norm(a, lp["post_attn_norm"], cfg.rms_eps)
+        if want_attn:
+            buf = _capture_into(buf, attn_out, i, cap_arr, _SITE_ATTN, cap_sites)
+        resid = resid + attn_out
+        mlp = _mlp(_rms_norm(resid, lp["pre_ffw_norm"], cfg.rms_eps), lp)
+        mlp_out = _rms_norm(mlp, lp["post_ffw_norm"], cfg.rms_eps)
+        if want_mlp:
+            buf = _capture_into(buf, mlp_out, i, cap_arr, _SITE_MLP, cap_sites)
+        resid = resid + mlp_out
+        return (resid, buf), None
+
+    stacked = jax.tree_util.tree_map(lambda x: x[:n_scan], params["layers"])
+    layer_ids = jnp.arange(n_scan, dtype=jnp.int32)
+    (resid, buf), _ = jax.lax.scan(body, (resid, buf), (stacked, layer_ids))
+    return _capture_into(buf, resid, jnp.int32(n_scan), cap_arr, _SITE_RESID,
+                         cap_sites)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "capture", "n_scan", "page_size", "use_kernel",
+                     "pad_mode", "out_dtype"),
+)
+def _paged_multi_impl(
+    params_tuple, plane_tokens, pos2d, doc_idx, plane_idx, lengths,
+    cfg: LMConfig, capture: tuple[tuple[int, int], ...], n_scan: int,
+    page_size: int, use_kernel: bool, pad_mode: str = "zero", out_dtype=None,
+):
+    D, S = doc_idx.shape
+    n_cap = len(capture)
+    outs = []
+    for p in params_tuple:
+        buf = _paged_capture_one(
+            p, plane_tokens, pos2d, doc_idx, plane_idx, lengths, cfg,
+            capture, n_scan, page_size, use_kernel,
+        )
+        flat = buf.reshape(n_cap, -1, cfg.d_model)
+        docs = flat[:, doc_idx]                    # [n_cap, D, S, d_model]
+        outs.extend(docs[i] for i in range(n_cap))
+    out = jnp.stack(outs, axis=2)                  # [D, S, n_sources, d]
+    t = jnp.arange(S)[None]                        # [1, S]
+    if pad_mode == "zero":
+        # the emitted stream carries an explicit valid-length mask
+        # instead of the padded path's garbage pad rows
+        valid = t < lengths[:, None]
+        out = jnp.where(valid[:, :, None, None], out, jnp.zeros((), out.dtype))
+    else:                                          # "wrap" (the replay buffer)
+        # pad positions cycle the document's own post-BOS rows, so every
+        # emitted row is a REAL activation and the replay store never
+        # trains on zero vectors; single-token documents (no post-BOS
+        # rows) fall back to their BOS row
+        ln = lengths[:, None]
+        src = jnp.where(t < ln, t, 1 + (t - 1) % jnp.maximum(ln - 1, 1))
+        src = jnp.where((t >= ln) & (ln == 1), 0, src)
+        out = jnp.take_along_axis(out, src[:, :, None, None], axis=1)
+    return out.astype(out_dtype) if out_dtype is not None else out
+
+
+def run_with_cache_multi_paged(
+    params_seq: Sequence[LMParams],
+    tokens,
+    lengths,
+    cfg: LMConfig,
+    hook_points: Sequence[str],
+    *,
+    page_size: int,
+    n_rows: int | None = None,
+    row_multiple: int = 1,
+    batch_sharding: Any | None = None,
+    pad_mode: str = "zero",
+    out_dtype=None,
+) -> jax.Array:
+    """All models' captures through the PAGED runtime: mixed-length
+    documents (``tokens [D, seq_len]`` padded layout + per-document
+    ``lengths``) are packed host-side into a dense token plane
+    (:func:`crosscoder_tpu.data.paging.pack_chunk`), the forward runs on
+    the plane with per-document ragged attention, and the result is
+    unpacked back to the padded layout: ``[D, seq_len, n_models·n_hooks,
+    d_model]``, source axis model-major — shape/order-compatible with
+    :func:`run_with_cache_multi`, with positions at ``t >= lengths[d]``
+    zeroed (``pad_mode="zero"``, the valid-length mask made material) or
+    cycled from the document's own post-BOS rows (``pad_mode="wrap"`` —
+    the replay buffer's choice, so no all-zero row ever becomes training
+    data; single-token documents fall back to their BOS row).
+
+    On an all-full-length chunk the packing is the identity layout and the
+    output is BIT-identical to :func:`run_with_cache_multi` — the CPU
+    parity gate ``tests/test_paging.py`` pins. On ragged chunks the plane
+    has ``~sum(len)/seq_len`` rows instead of ``D``, so the projections/
+    MLP (the dominant harvest cost) scale with real tokens; the Pallas
+    ragged-paged-attention kernel (``CROSSCODER_PAGED_ATTN_PALLAS=1``)
+    makes attention ragged too.
+    """
+    from crosscoder_tpu.data import paging
+
+    cap_pairs = _hook_layers(cfg, tuple(hook_points))
+    n_scan = min(cfg.n_layers, _scan_stop(cap_pairs))
+    chunk = paging.pack_chunk(
+        np.asarray(tokens), np.asarray(lengths),
+        n_rows=n_rows, row_multiple=row_multiple,
+    )
+    from crosscoder_tpu.ops import paged_attention as pa
+
+    use_kernel = pa.kernel_enabled() and pa.supported(
+        chunk.n_docs, chunk.seq_len, cfg.n_heads, cfg.n_kv_heads,
+        cfg.head_dim, page_size,
+    )
+    plane = jnp.asarray(chunk.tokens)
+    if batch_sharding is not None:
+        plane = jax.device_put(plane, batch_sharding)
+    if pad_mode not in ("zero", "wrap"):
+        raise ValueError(f"pad_mode must be zero|wrap, got {pad_mode!r}")
+    return _paged_multi_impl(
+        tuple(params_seq), plane, jnp.asarray(chunk.pos),
+        jnp.asarray(chunk.doc_idx), jnp.asarray(chunk.plane_idx),
+        jnp.asarray(chunk.lengths), cfg, cap_pairs, n_scan, page_size,
+        use_kernel, pad_mode, out_dtype,
+    )
 
 
 # ---------------------------------------------------------------------------

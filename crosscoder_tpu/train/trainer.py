@@ -629,6 +629,14 @@ class Trainer:
             # actually happened (snapshot of an untouched instance is {}),
             # so fault-free runs log exactly the reference's scalar surface
             scalars.update(self.resilience.snapshot())
+            # paged harvest runtime only (padded runs log exactly the
+            # reference's scalar surface): the running real-token fraction
+            # of everything harvested — the live denominator of the
+            # runtime's matmul win (docs/SCALING.md "Harvest cost model")
+            eff = getattr(self.buffer, "padding_efficiency", None)
+            eff = eff() if callable(eff) else None
+            if eff is not None:
+                scalars["harvest/padding_efficiency"] = eff
             self.logger.log(scalars, step)
 
     # --- divergence guard + rollback (cfg.guard_loss; docs/resilience.md) --

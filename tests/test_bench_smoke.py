@@ -19,7 +19,7 @@ def test_bench_emits_one_json_headline():
     env = dict(os.environ)
     env.update(
         BENCH_TINY="1", BENCH_CPU="1",
-        BENCH_SECTIONS="step,e2e",
+        BENCH_SECTIONS="step,e2e,harvest",
         BENCH_STEPS="4", BENCH_E2E_STEPS="4",
         BENCH_DIN="32", BENCH_DICT="256", BENCH_BATCH="64",
         JAX_PLATFORMS="cpu",
@@ -37,3 +37,7 @@ def test_bench_emits_one_json_headline():
         assert key in out, key
     assert out["value"] and out["value"] > 0
     assert out["e2e"]["loss_finite"] is True
+    # the harvest section's contract (speedup itself is shape-dependent:
+    # toy dims are dispatch-bound, so only the fields are asserted here)
+    assert 0 < out["harvest"]["padding_efficiency"] <= 1
+    assert out["harvest"]["paged_step_ms"] > 0

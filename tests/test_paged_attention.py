@@ -1,0 +1,148 @@
+"""Ragged paged attention (ops/paged_attention.py): interpret-mode kernel
+parity against the pure-XLA oracle, the oracle's bit-consistency with the
+padded LM attention core, page-pool construction, and the dispatch/support
+gates. All CPU, tier-1; also part of scripts/kernels.sh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from crosscoder_tpu.models import lm
+from crosscoder_tpu.ops import paged_attention as pa
+
+D, S, H, KV, HD = 5, 16, 4, 2, 8
+SCALE = 0.35
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(D, S, H, HD)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(D, S, KV, HD)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(D, S, KV, HD)).astype(np.float32))
+    return q, k, v
+
+
+# mixed lengths incl. the edge docs: single-token and max-length
+LENGTHS = np.array([1, 16, 7, 9, 3], np.int32)
+
+
+@pytest.mark.parametrize("window,softcap", [(0, 0.0), (4, 50.0), (0, 50.0),
+                                            (8, 0.0)])
+def test_kernel_matches_oracle(qkv, window, softcap):
+    """Online-softmax kernel == masked-softmax oracle at valid positions
+    (reassociation tolerance), across global/local masks and softcap."""
+    q, k, v = qkv
+    lengths = jnp.asarray(LENGTHS)
+    want = np.asarray(pa.ragged_attention_reference(
+        q, k, v, lengths, scale=SCALE, softcap=softcap, window=window,
+        is_local=bool(window),
+    ))
+    got = np.asarray(pa.paged_attention(
+        q, k, v, lengths, page_size=8, scale=SCALE, softcap=softcap,
+        window=window, interpret=True,
+    ))
+    for d, L in enumerate(LENGTHS):
+        np.testing.assert_allclose(
+            got[d, :L], want[d, :L], rtol=2e-5, atol=2e-5,
+            err_msg=f"doc {d}, window {window}",
+        )
+
+
+def test_kernel_page_size_one_and_full(qkv):
+    """Degenerate page sizes: 1 token/page (S pages) and S tokens/page
+    (one page) bracket the loop structure."""
+    q, k, v = qkv
+    lengths = jnp.asarray(LENGTHS)
+    want = np.asarray(pa.ragged_attention_reference(
+        q, k, v, lengths, scale=SCALE, softcap=0.0, window=0, is_local=False,
+    ))
+    for page in (1, S):
+        got = np.asarray(pa.paged_attention(
+            q, k, v, lengths, page_size=page, scale=SCALE, window=0,
+            interpret=True,
+        ))
+        for d, L in enumerate(LENGTHS):
+            np.testing.assert_allclose(
+                got[d, :L], want[d, :L], rtol=2e-5, atol=2e-5,
+                err_msg=f"page {page}, doc {d}",
+            )
+
+
+def test_oracle_bit_matches_lm_attn_core(qkv):
+    """The XLA reference is op-for-op the padded LM attention plus the
+    length mask — for full-length documents the outputs must be BITWISE
+    equal (the chain that makes the paged harvest's CPU parity gate
+    exact)."""
+    q, k, v = qkv
+    cfg = lm.LMConfig.tiny().replace(
+        n_heads=H, n_kv_heads=KV, head_dim=HD,
+        query_pre_attn_scalar=SCALE ** -2, sliding_window=4,
+    )
+    full = jnp.full((D,), S, jnp.int32)
+    for is_local in (False, True):
+        want = lm._attn_core(q, k, v, cfg, jnp.asarray(is_local))
+        got = pa.ragged_attention_reference(
+            q, k, v, full, scale=cfg.query_pre_attn_scalar ** -0.5,
+            softcap=cfg.attn_softcap, window=cfg.sliding_window,
+            is_local=jnp.asarray(is_local),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=f"is_local={is_local}"
+        )
+
+
+def test_paginate_kv_roundtrip(qkv):
+    """The page pool + identity table reconstruct K/V exactly."""
+    _, k, v = qkv
+    kv_pages, tbl = pa.paginate_kv(k, v, page_size=4)
+    assert kv_pages.shape == (D * 4, 2, KV, 4, HD)
+    assert tbl.shape == (D, 4)
+    for d in (0, 3):
+        for j in range(4):
+            page = kv_pages[tbl[d, j]]
+            np.testing.assert_array_equal(
+                np.asarray(page[0]),                    # [KV, page, hd]
+                np.asarray(k[d, 4 * j: 4 * j + 4].transpose(1, 0, 2)),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(page[1]),
+                np.asarray(v[d, 4 * j: 4 * j + 4].transpose(1, 0, 2)),
+            )
+    with pytest.raises(ValueError, match="not divisible"):
+        pa.paginate_kv(k, v, page_size=5)
+
+
+def test_supported_gates():
+    assert pa.supported(4, 16, 4, 2, 8, 8)
+    assert not pa.supported(4, 16, 4, 2, 8, 5)       # not a power of two
+    assert not pa.supported(4, 16, 4, 2, 8, 32)      # page !| seq_len
+    assert not pa.supported(4, 16, 3, 2, 8, 8)       # heads !| kv heads
+    # VMEM budget: a huge per-doc block must be rejected
+    assert not pa.supported(4, 64 * 1024, 64, 1, 256, 8)
+
+
+def test_dispatch_falls_back_without_optin(qkv, monkeypatch):
+    """Neither interpret mode nor the env opt-in: paged_attention must
+    route to the XLA reference (identical output), never the kernel."""
+    q, k, v = qkv
+    lengths = jnp.asarray(LENGTHS)
+    monkeypatch.delenv(pa.DISPATCH_ENV, raising=False)
+    called = {}
+    real = pa._rpa_call
+
+    def spy(*a, **kw):
+        called["kernel"] = True
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pa, "_rpa_call", spy)
+    got = pa.paged_attention(
+        q, k, v, lengths, page_size=8, scale=SCALE, interpret=False,
+    )
+    want = pa.ragged_attention_reference(
+        q, k, v, lengths, scale=SCALE, softcap=0.0, window=0, is_local=False,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert "kernel" not in called

@@ -37,3 +37,67 @@ def test_npy_cache_roundtrip_with_rechunk(tmp_path):
     out = tok_mod.load_pile_lmsys_mixed_tokens(cfg)
     assert out.shape == (4, 32)
     np.testing.assert_array_equal(out[0], np.arange(32))
+
+
+# ---------------------------------------------------------------------------
+# ragged lengths + distribution stats (the paged harvest runtime's inputs)
+
+
+def test_valid_lengths():
+    t = np.array([
+        [5, 6, 7, 8],        # full
+        [5, 6, 0, 0],        # trailing pads
+        [5, 0, 7, 0],        # interior pad is CONTENT (only the tail trims)
+        [0, 0, 0, 0],        # pure padding -> length 1 (the BOS slot)
+        [5, 0, 0, 0],        # single token
+    ], np.int32)
+    np.testing.assert_array_equal(
+        tok_mod.valid_lengths(t), [4, 2, 3, 1, 1]
+    )
+
+
+def test_length_stats_histogram_and_efficiency():
+    lengths = np.array([1, 4, 4, 8, 8, 8])
+    s = tok_mod.length_stats(lengths, seq_len=8, n_buckets=4)
+    assert s["n_sampled"] == 6 and s["seq_len"] == 8
+    assert sum(s["bucket_counts"]) == 6
+    assert s["min_len"] == 1 and s["max_len"] == 8
+    want_eff = lengths.sum() / (6 * 8)
+    assert s["padding_efficiency"] == pytest.approx(want_eff, abs=1e-4)
+    assert s["paged_matmul_speedup_estimate"] == pytest.approx(
+        1 / want_eff, abs=0.01
+    )
+
+
+def test_length_stats_from_token_matrix():
+    t = np.array([[3, 4, 0, 0], [3, 4, 5, 6]], np.int32)
+    s = tok_mod.length_stats(t)
+    assert s["seq_len"] == 4
+    assert s["padding_efficiency"] == pytest.approx(6 / 8, abs=1e-4)
+    with pytest.raises(ValueError, match="seq_len is required"):
+        tok_mod.length_stats(np.array([1, 2]))
+
+
+def test_length_stats_samples_evenly_across_ordered_corpus():
+    """The sample strides the whole corpus: a corpus stored as full-length
+    rows followed by ragged rows must not report 100% efficiency off a
+    head sample."""
+    full = np.ones((1000, 8), np.int32)
+    ragged = np.ones((1000, 8), np.int32)
+    ragged[:, 2:] = 0                                # length 2
+    s = tok_mod.length_stats(np.vstack([full, ragged]), sample_rows=100)
+    assert 0.5 < s["padding_efficiency"] < 0.75      # ~ (8+2)/16 = 0.625
+    # sample_rows < n_rows < 2*sample_rows: floor-division stride would be
+    # 1 (a pure head sample reporting 1.0); ceil must stride the whole span
+    s = tok_mod.length_stats(np.vstack([full, ragged]), sample_rows=700)
+    assert 0.5 < s["padding_efficiency"] < 0.75
+
+
+def test_loader_emits_length_stats(tmp_path, capsys):
+    corpus = np.arange(1, 8 * 16 + 1, dtype=np.int32).reshape(8, 16)
+    cfg = CrossCoderConfig(data_dir=str(tmp_path), dataset_name="x/demo2",
+                           seq_len=16)
+    np.save(tmp_path / "demo2.npy", corpus)
+    tok_mod.load_pile_lmsys_mixed_tokens(cfg)
+    out = capsys.readouterr().out
+    assert "padding efficiency" in out and "100.00%" in out
