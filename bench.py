@@ -1,6 +1,6 @@
 """Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Seven sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
+Eight sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
 exactly ONE machine-parseable JSON line on stdout, guaranteed last —
 stray prints are rerouted to stderr for the whole run):
 
@@ -31,6 +31,10 @@ stray prints are rerouted to stderr for the whole run):
   "Quantized data plane"): roundtrip per-row MSE on a Gemma-shaped
   heavy-tailed probe, store-byte ratio, and the quantized grad
   all-reduce's one-shot + error-feedback accuracy on the local mesh.
+- **obs**: the telemetry plane's cost gates (docs/OBSERVABILITY.md):
+  SpanTracer spans/s, per-step overhead of ``cfg.obs`` on vs off at the
+  reference shape (gate: <1%), and the ``perf/refill_bubble_frac`` a
+  standard training leg emits.
 - **dash**: dashboard generation at the reference's recorded workload
   (128 seqs × 3 features, minibatch 4 — BASELINE.md: ≈19 s on A100).
 
@@ -805,6 +809,95 @@ def section_quant() -> dict:
     return out
 
 
+def section_obs() -> dict:
+    """Observability-plane gates (docs/OBSERVABILITY.md), recorded every
+    round so tracer cost can never silently regress:
+
+    - **spans/s**: raw SpanTracer record throughput (enter + exit +
+      event append + registry EMA);
+    - **per-step overhead**: the Trainer stepped with obs off vs on at the
+      reference shape on a fixed pre-generated batch (so both arms time
+      step dispatch + telemetry, not synthetic-data generation). Gate:
+      <1% step-time overhead (``overhead_gate_ok``).
+    - **bubble fraction**: a short standard training leg with obs on —
+      the ``perf/refill_bubble_frac`` the plane emits at every log point.
+    """
+    import tempfile
+
+    from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+    from crosscoder_tpu.obs.trace import SpanTracer
+    from crosscoder_tpu.train.trainer import Trainer
+
+    tiny = os.environ.get("BENCH_TINY") == "1"    # CI/debug only
+    shape = dict(d_in=32, dict_size=256, batch_size=64) if tiny else {}
+
+    # tracer microbenchmark
+    tracer = SpanTracer(os.path.join(tempfile.mkdtemp(), "t.json"))
+    n_spans = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n_spans):
+        with tracer.span("bench"):
+            pass
+    spans_per_sec = n_spans / (time.perf_counter() - t0)
+
+    class FixedSource:
+        """One pre-generated batch, re-served — production cost ~0, so
+        the on/off A/B isolates the telemetry on the step path."""
+
+        def __init__(self, cfg):
+            self._batch = SyntheticActivationSource(cfg).next()
+
+        def next(self):
+            return self._batch
+
+    steps = int(os.environ.get("BENCH_OBS_STEPS", 20 if tiny else 16))
+    step_ms = {"off": float("inf"), "on": float("inf")}
+    # two rounds per arm, min taken: the first Trainer in a process pays
+    # one-time backend/init costs that would masquerade as (negative)
+    # overhead on fast-step shapes
+    for _round in range(2):
+        for mode in ("off", "on"):
+            cfg = _make_cfg(**shape, num_tokens=10**12, save_every=10**9,
+                            obs=mode, prefetch=False,
+                            checkpoint_dir=tempfile.mkdtemp())
+            tr = Trainer(cfg, buffer=FixedSource(cfg))
+            for _ in range(5):
+                m = tr.step(full_metrics=False)
+            _sync(m["loss"])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                m = tr.step(full_metrics=False)
+            _sync(m["loss"])
+            step_ms[mode] = min(
+                step_ms[mode], 1000 * (time.perf_counter() - t0) / steps
+            )
+            tr.close()
+    overhead = step_ms["on"] / step_ms["off"] - 1.0
+
+    # bubble fraction on a standard (synthetic-production) training leg
+    cfg = _make_cfg(**shape, num_tokens=10**12, save_every=10**9, obs="on",
+                    log_every=8, prefetch=False,
+                    checkpoint_dir=tempfile.mkdtemp())
+    tr = Trainer(cfg)
+    tr.train(num_steps=17)                      # logs at 0, 8, 16
+    bubble = tr._obs.registry.get_gauge("perf/refill_bubble_frac")
+
+    out = {
+        "spans_per_sec": round(spans_per_sec, 1),
+        "span_overhead_us": round(1e6 / spans_per_sec, 3),
+        "step_ms_obs_off": round(step_ms["off"], 3),
+        "step_ms_obs_on": round(step_ms["on"], 3),
+        "obs_overhead_frac": round(overhead, 5),
+        "overhead_gate_ok": bool(overhead < 0.01),
+        "refill_bubble_frac": (round(float(bubble), 4)
+                               if bubble is not None else None),
+        "workload": (f"{'tiny' if tiny else 'reference'} shape, "
+                     f"{steps}-step on/off A/B on a fixed batch"),
+    }
+    log(f"[obs] {out}")
+    return out
+
+
 def section_dash() -> dict:
     """Dashboard generation at the reference's recorded sae_vis workload:
     128 seqs × 3 features, minibatch 4 (BASELINE.md: fwd 14.08 s + feature
@@ -885,13 +978,13 @@ def _run_sections() -> dict:
     except OSError:
         cache_state = "cold"
     sections = os.environ.get(
-        "BENCH_SECTIONS", "step,matrix,configs,e2e,harvest,quant,dash"
+        "BENCH_SECTIONS", "step,matrix,configs,e2e,harvest,quant,obs,dash"
     ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
                      ("configs", section_configs),
                      ("e2e", section_e2e), ("harvest", section_harvest),
-                     ("quant", section_quant),
+                     ("quant", section_quant), ("obs", section_obs),
                      ("dash", section_dash)):
         if name not in sections:
             continue

@@ -36,6 +36,7 @@ import jax
 import numpy as np
 
 from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.obs import trace
 
 
 def _sha256_file(path: Path) -> str:
@@ -216,7 +217,24 @@ class Checkpointer:
         previous write) and atomic (tmp + ``os.replace``, meta last, so a
         kill mid-write never leaves a torn save that ``restore`` could
         read). Call :meth:`wait` (Trainer.close does) before process exit.
+
+        Telemetry (docs/OBSERVABILITY.md; no-ops without a tracer): the
+        ``save`` span brackets the loop-blocking portion (previous-write
+        join + collective fetch), ``save_write`` the file write — on the
+        writer thread for background saves, so the trace shows exactly how
+        much of each save overlapped training.
         """
+        with trace.span("save", version=self.save_version,
+                        background=background):
+            return self._save_impl(state, cfg, buffer, background)
+
+    def _save_impl(
+        self,
+        state: Any,
+        cfg: CrossCoderConfig,
+        buffer: Any | None,
+        background: bool,
+    ) -> Path | None:
         # collective fetches first, identical order on all processes; each
         # leaf crosses the network ONCE — the weights artifact reuses the
         # same fetched arrays via an identity cache (no reliance on how
@@ -262,26 +280,30 @@ class Checkpointer:
                 # per-artifact SHA-256, recorded in the meta marker so
                 # restore can prove the bytes it reads are the bytes that
                 # were written (bit-rot / partial-page corruption slips
-                # past the presence-only torn-save check)
-                sums = {
-                    f"{v}.npz": _atomic_savez(save_dir / f"{v}.npz", weights),
-                    f"{v}_cfg.json": _atomic_write_text(
-                        save_dir / f"{v}_cfg.json", cfg.to_json_str()
-                    ),
-                    f"{v}_train_state.npz": _atomic_savez(
-                        save_dir / f"{v}_train_state.npz", flat_state
-                    ),
-                }
-                meta["checksums"] = sums
-                # meta LAST: its presence marks the save complete —
-                # latest_save keys off it, so a torn save is unreadable
-                _atomic_write_text(
-                    save_dir / f"{v}_meta.json", json.dumps(meta, indent=2)
-                )
-                self._prune_saves(save_dir, cfg.keep_saves)
-                if self.chaos is not None:
-                    self.chaos.corrupt_save(save_dir, v)
-                print(f"Saved as version {v} in {save_dir}")
+                # past the presence-only torn-save check). The save_write
+                # span lands on whichever thread runs the write — the
+                # writer thread for background saves, so the trace shows
+                # the write overlapping subsequent steps.
+                with trace.span("save_write", version=v):
+                    sums = {
+                        f"{v}.npz": _atomic_savez(save_dir / f"{v}.npz", weights),
+                        f"{v}_cfg.json": _atomic_write_text(
+                            save_dir / f"{v}_cfg.json", cfg.to_json_str()
+                        ),
+                        f"{v}_train_state.npz": _atomic_savez(
+                            save_dir / f"{v}_train_state.npz", flat_state
+                        ),
+                    }
+                    meta["checksums"] = sums
+                    # meta LAST: its presence marks the save complete —
+                    # latest_save keys off it, so a torn save is unreadable
+                    _atomic_write_text(
+                        save_dir / f"{v}_meta.json", json.dumps(meta, indent=2)
+                    )
+                    self._prune_saves(save_dir, cfg.keep_saves)
+                    if self.chaos is not None:
+                        self.chaos.corrupt_save(save_dir, v)
+                    print(f"Saved as version {v} in {save_dir}")
 
             if background:
                 def guarded() -> None:
@@ -497,6 +519,13 @@ class Checkpointer:
         whose local filesystem view is ahead rolls back with the rest);
         an explicitly requested ``save`` is the caller's agreement and is
         verified but not negotiated — corruption there raises."""
+        with trace.span("restore"):
+            return self._restore_impl(cfg, tx, version_dir, save)
+
+    def _restore_impl(
+        self, cfg: CrossCoderConfig, tx: Any,
+        version_dir: str | Path | None, save: int | None,
+    ) -> tuple[Any, dict]:
         from crosscoder_tpu.train.state import init_train_state
 
         self.wait()  # a background write from THIS instance must land first

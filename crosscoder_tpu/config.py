@@ -316,6 +316,34 @@ class CrossCoderConfig:
                                     # last-axis granularity). Must divide
                                     # d_in when quant_buffer is on; store
                                     # overhead is 4/quant_block bytes/elem
+    # --- observability (crosscoder_tpu/obs; docs/OBSERVABILITY.md) ---
+    # Everything off by default and ZERO-COST off: with obs="off" the
+    # compiled train step is byte-identical to a build without the plane
+    # and no additional host↔device transfer happens anywhere (asserted
+    # in tests/test_obs.py).
+    obs: str = "off"                # "on": span tracer (Chrome trace-event
+                                    # JSON under obs_dir, Perfetto-viewable,
+                                    # host spans wrapped in jax.profiler
+                                    # TraceAnnotations), perf/* + comm/*
+                                    # registry metrics in the log stream
+                                    # (incl. perf/refill_bubble_frac),
+                                    # compile-event reporting, SIGUSR1
+                                    # profiler windows
+    obs_dir: str = ""               # telemetry output dir; default
+                                    # <checkpoint_dir>/obs (trace.json,
+                                    # profile/ windows)
+    profile_steps: str = ""         # "start:stop": capture a jax.profiler
+                                    # device trace around exactly steps
+                                    # [start, stop) — absolute step
+                                    # indices; independent of cfg.obs.
+                                    # Empty + profile_dir set keeps the
+                                    # legacy steps-10..14 window.
+    log_print_every: int = 1        # echo every Nth metrics line to
+                                    # STDERR (0 = never). The echo left
+                                    # stdout so executables owning a
+                                    # machine-readable stdout contract
+                                    # (bench.py's one-JSON-line) can
+                                    # construct a real logger safely.
     # AuxK dead-mask cadence: how often the trainer REFRESHES the dead-
     # latent mask that gates the aux ranking/decode. 1 (default) =
     # recompute every step (the exact Gao et al. recipe — required for
@@ -575,6 +603,17 @@ class CrossCoderConfig:
                 "the quantized step computes per-device losses, but "
                 "batchtopk's threshold is a GLOBAL-batch order statistic"
             )
+        if self.obs not in ("off", "on"):
+            raise ValueError(f"obs must be off|on, got {self.obs!r}")
+        if self.log_print_every < 0:
+            raise ValueError(
+                f"log_print_every must be >= 0 (0 = never echo), got "
+                f"{self.log_print_every}"
+            )
+        if self.profile_steps:
+            from crosscoder_tpu.obs.profiler import parse_profile_steps
+
+            parse_profile_steps(self.profile_steps)   # raises on a bad spec
         if self.aux_mask_every < 0:
             raise ValueError(
                 f"aux_mask_every must be >= 0 (1 = per-step exact, N = "

@@ -54,6 +54,7 @@ import numpy as np
 from crosscoder_tpu import native
 from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.models import lm
+from crosscoder_tpu.obs import trace
 from crosscoder_tpu.utils import pipeline
 
 _BF16 = np.dtype(jnp.bfloat16.dtype)
@@ -547,17 +548,29 @@ class PairedActivationBuffer:
         while credit > 0 and self._step_job():
             credit -= 1
         while self._head_drainable():
-            self._drain_one()
+            # span site (docs/OBSERVABILITY.md): one harvest chunk landing
+            # (device fetch + store scatter) — a no-op unless a tracer is
+            # installed (cfg.obs="on")
+            with trace.span("harvest"):
+                self._drain_one()
 
     def _finish_cycle(self) -> None:
         """Complete the cycle: dispatch the remainder (none in steady
         state — the paced dispatches have already finished), land
-        everything, re-shuffle, reset the read pointer."""
-        while self._cyc_seq_done < self._cyc_batches or self._cyc_job is not None:
-            if not self._step_job():        # depth window full: free a slot
-                self._drain_one()
-        while self._cyc_inflight:
-            self._drain_one()
+        everything, re-shuffle, reset the read pointer.
+
+        The ``refill`` span here brackets the serve-trigger completion —
+        the residual refill bubble the incremental dispatches exist to
+        amortize, now directly visible per cycle in the trace."""
+        with trace.span("refill", target_rows=self._cyc_target):
+            while (self._cyc_seq_done < self._cyc_batches
+                   or self._cyc_job is not None):
+                if not self._step_job():    # depth window full: free a slot
+                    with trace.span("harvest"):
+                        self._drain_one()
+            while self._cyc_inflight:
+                with trace.span("harvest"):
+                    self._drain_one()
         assert self._cyc_drained == self._cyc_write == self._cyc_target
         self._cyc_seq_done = 0      # cycle consumed: nothing left to abandon
         self._perm = self._rng.permutation(self.buffer_size)

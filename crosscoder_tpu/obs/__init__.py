@@ -1,0 +1,153 @@
+"""Unified telemetry plane (``cfg.obs``; docs/OBSERVABILITY.md).
+
+One object — :class:`Observability` — owns the three telemetry channels
+and their lifecycle:
+
+- a :class:`~crosscoder_tpu.obs.trace.SpanTracer` installed as the
+  process-global tracer, so the span sites in the buffer, checkpointer,
+  and watchdog light up without those objects growing constructor
+  parameters; spans feed per-name EMA timers into the registry;
+- a :class:`~crosscoder_tpu.obs.registry.MetricsRegistry` whose snapshot
+  the Trainer merges into the metrics stream (``perf/*`` and ``comm/*``
+  keys) exactly like the resilience counters — the resilience channel is
+  now simply the oldest of the registry's siblings;
+- compile/comm observability: step-variant compilations are reported
+  (variant key, wall time, HLO cost-analysis FLOPs/bytes) via
+  :func:`crosscoder_tpu.utils.compile_cache.observed`, and each compiled
+  step's collectives are accounted through
+  :mod:`crosscoder_tpu.parallel.comm_model` into
+  ``comm/predicted_wire_bytes`` — logged next to the measured host↔device
+  transfer counters (``comm/h2d_transfers``/``comm/d2h_transfers``), so
+  drift between the PR-2 wire-byte model and the program actually running
+  is visible in every log line.
+
+Off by default: with ``cfg.obs == "off"`` the Trainer never constructs
+this object, every library span site hits the shared
+:class:`~crosscoder_tpu.obs.trace.NullTracer` no-op, the compiled step
+HLO is byte-identical to a build without the plane, and zero additional
+host↔device transfers occur (regression-tested in tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any
+
+from crosscoder_tpu.obs import trace
+from crosscoder_tpu.obs.registry import MetricsRegistry
+from crosscoder_tpu.obs.trace import NullTracer, SpanTracer
+
+
+class Observability:
+    def __init__(self, cfg: Any, mesh: Any | None = None) -> None:
+        self.cfg = cfg
+        self.out_dir = cfg.obs_dir or os.path.join(cfg.checkpoint_dir, "obs")
+        self.registry = MetricsRegistry()
+        # per-process trace file: on a multi-host pod with a shared
+        # checkpoint_dir, every process traces its own host threads
+        try:
+            import jax
+
+            idx = jax.process_index()
+        except Exception:
+            idx = 0
+        name = "trace.json" if idx == 0 else f"trace.p{idx}.json"
+        self.tracer = SpanTracer(
+            os.path.join(self.out_dir, name), registry=self.registry
+        )
+        self._prev_tracer = trace.set_tracer(self.tracer)
+        self.mesh = mesh
+        # refill-wait accumulator: nanoseconds the train loop spent blocked
+        # on batch production since the last log point (the numerator of
+        # perf/refill_bubble_frac)
+        self._blocked_ns = 0
+        self._closed = False
+
+    # -- refill-bubble accounting (trainer hot path) --------------------
+    def add_blocked_ns(self, ns: int) -> None:
+        self._blocked_ns += ns
+
+    def take_blocked_s(self) -> float:
+        """Blocked-on-refill seconds since the last call (log-interval
+        reset)."""
+        ns, self._blocked_ns = self._blocked_ns, 0
+        return ns / 1e9
+
+    # -- compile/comm observability -------------------------------------
+    def observe_step(self, key: str, jit_fn: Any) -> Any:
+        """Wrap a jitted step variant so its compilation is measured and
+        reported (utils.compile_cache.observed)."""
+        from crosscoder_tpu.utils import compile_cache
+
+        return compile_cache.observed(jit_fn, key, self)
+
+    def on_compile(self, key: str, compiled: Any, wall_s: float) -> None:
+        """Report one compile event + the compiled program's collective
+        accounting. Never raises: a cost-analysis/HLO-parsing failure
+        degrades to the wall-time-only report."""
+        r = self.registry
+        r.count("perf/compiles")
+        r.observe("perf/compile_s", wall_s)
+        flops = bytes_ = None
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):    # older jax returns [dict]
+                cost = cost[0] if cost else {}
+            flops = cost.get("flops")
+            bytes_ = cost.get("bytes accessed")
+            if flops:
+                r.gauge("perf/compile_flops", float(flops))
+            if bytes_:
+                r.gauge("perf/compile_bytes_accessed", float(bytes_))
+        except Exception:
+            pass
+        try:
+            self._account_comm(compiled)
+        except Exception:
+            pass
+        print(f"[crosscoder_tpu] obs: compiled {key} in {wall_s:.2f}s"
+              + (f" ({flops / 1e9:.2f} GFLOP/step)" if flops else ""),
+              file=sys.stderr, flush=True)
+
+    def _account_comm(self, compiled: Any) -> None:
+        """Predicted per-device ICI wire bytes of the compiled step (the
+        PR-2 analytical model applied to the program ACTUALLY running),
+        logged as ``comm/*`` gauges next to the measured transfer
+        counters."""
+        from crosscoder_tpu.parallel import comm_model
+
+        hlo = compiled.as_text()
+        by_op = comm_model.collective_bytes(hlo)
+        n_dev = int(self.mesh.size) if self.mesh is not None else 1
+        model_axis = (int(self.mesh.shape.get("model", 1))
+                      if self.mesh is not None else 1)
+        profile = comm_model.CommProfile(
+            "train_step", n_dev, model_axis, by_op
+        )
+        self.registry.gauge("comm/predicted_wire_bytes",
+                            comm_model.wire_bytes(profile))
+        self.registry.gauge("comm/collective_output_bytes",
+                            float(profile.total_bytes))
+        self.registry.gauge("comm/collectives_per_step",
+                            float(by_op.get("count", 0)))
+
+    # -- lifecycle ------------------------------------------------------
+    def flush(self) -> None:
+        self.tracer.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        trace.set_tracer(self._prev_tracer)
+        self.tracer.close()
+
+
+__all__ = [
+    "Observability",
+    "MetricsRegistry",
+    "NullTracer",
+    "SpanTracer",
+    "trace",
+]

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from crosscoder_tpu.obs import trace
 from crosscoder_tpu.utils.logging import ResilienceCounters
 
 
@@ -73,7 +74,13 @@ class Watchdog:
 
             def runner() -> None:
                 try:
-                    outcome["value"] = fn()
+                    # span on the runner thread: a stalled call shows up
+                    # in the trace as one long watchdog_call span with
+                    # watchdog_stall instants from the waiting thread
+                    # alongside it (no-op without a tracer; cfg.obs)
+                    with trace.span("watchdog_call", watched=self.name,
+                                    attempt=attempt):
+                        outcome["value"] = fn()
                 except BaseException as e:
                     outcome["error"] = e
                 finally:
@@ -97,6 +104,8 @@ class Watchdog:
                     )
                 extensions += 1
                 self.counters.bump(f"{self.name}_timeouts")
+                trace.instant("watchdog_stall", watched=self.name,
+                              waited_s=patience)
                 print(f"[crosscoder_tpu] watchdog: {self.name} stall "
                       f"#{extensions} (waited {patience:.1f}s); "
                       f"extending wait", flush=True)
@@ -109,6 +118,8 @@ class Watchdog:
             attempt += 1
             delay = self.backoff_s * 2 ** (attempt - 1)
             self.counters.bump(f"{self.name}_retries")
+            trace.instant("watchdog_retry", watched=self.name,
+                          attempt=attempt, error=type(err).__name__)
             print(f"[crosscoder_tpu] watchdog: {self.name} failed "
                   f"({type(err).__name__}: {err}); retry {attempt}/"
                   f"{self.retries} in {delay:.2f}s", flush=True)

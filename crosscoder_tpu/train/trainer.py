@@ -30,6 +30,7 @@ import contextlib
 import functools
 import math
 import threading
+import time
 import types
 from typing import Any, Callable
 
@@ -360,6 +361,19 @@ class Trainer:
                     backoff_s=cfg.harvest_backoff_s, name="harvest",
                     counters=self.resilience,
                 )
+        # --- observability (cfg.obs; docs/OBSERVABILITY.md) ------------
+        # None when off (the default): every hook below is a plain
+        # is-None check — the compiled step HLO and the transfer counts
+        # are byte-identical to a build without the plane
+        # (tests/test_obs.py). When on: span tracer installed process-
+        # globally (buffer/checkpointer/watchdog spans light up), perf/*
+        # and comm/* registry metrics merge into the log stream, and step
+        # compiles are AOT'd + reported via utils.compile_cache.observed.
+        self._obs = None
+        if cfg.obs == "on":
+            from crosscoder_tpu.obs import Observability
+
+            self._obs = Observability(cfg, mesh=self.mesh)
 
         self._tx = tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
         # n_data pins the quant_grads error-feedback residual shapes to
@@ -394,7 +408,10 @@ class Trainer:
         # mirror picks the variant without a device sync. cfg.sparse_bwd
         # adds no key: its tier scope follows aux_on (see make_train_step).
         self._step_fns: dict[tuple[bool, bool, bool], Callable] = {
-            (True, True, True): make_train_step(cfg, self.mesh, tx, self._state_shardings)
+            (True, True, True): self._wrap_step(
+                (True, True, True),
+                make_train_step(cfg, self.mesh, tx, self._state_shardings),
+            )
         }
         self._host_step = 0
         self._batch_sharding = mesh_lib.batch_sharding(self.mesh)
@@ -462,6 +479,15 @@ class Trainer:
     def step_counter(self) -> int:
         return int(self.state.step)
 
+    def _wrap_step(self, key: tuple[bool, bool, bool], fn: Callable) -> Callable:
+        """Compile-event observation for one step variant (obs on only;
+        with obs off the jitted fn is returned untouched, so the off path
+        calls exactly what it always called)."""
+        if self._obs is None:
+            return fn
+        label = ("train_step(metrics={}, aux={}, refresh={})".format(*key))
+        return self._obs.observe_step(label, fn)
+
     def _device_scale(self) -> jax.Array:
         """Replicated per-source scale, re-uploaded only when the factors'
         VALUES change (calibration / resume) — cached by value, not object
@@ -507,6 +533,11 @@ class Trainer:
             batch = self._watchdog.call(lambda: self._serve_once(serve))
         else:
             batch = self._serve_once(serve)
+        if self._obs is not None:
+            # measured transfer accounting (comm/*): one host→device batch
+            # upload per produced batch (a no-op put for device-resident
+            # stores — still the serve path's dispatch, counted as such)
+            self._obs.registry.count("comm/h2d_transfers")
         with self._dispatch_lock:
             return jax.device_put(batch, self._batch_sharding), self._device_scale()
 
@@ -567,6 +598,9 @@ class Trainer:
         if self.checkpointer is not None and hasattr(self.checkpointer, "wait"):
             # land any background checkpoint write before process exit
             self.checkpointer.wait()
+        if self._obs is not None:
+            # write the trace file and hand the process-global tracer back
+            self._obs.close()
 
     def step(self, full_metrics: bool = True) -> dict[str, jax.Array]:
         """One optimizer step; returns device-resident metrics (no sync).
@@ -590,12 +624,22 @@ class Trainer:
         key = (full_metrics, aux_on, mask_refresh)
         fn = self._step_fns.get(key)
         if fn is None:
-            fn = self._step_fns[key] = make_train_step(
+            fn = self._step_fns[key] = self._wrap_step(key, make_train_step(
                 cfg, self.mesh, self._tx, self._state_shardings,
                 with_metrics=full_metrics, aux_on=aux_on,
                 mask_refresh=mask_refresh,
-            )
-        batch, scale = self._next_batch()
+            ))
+        if self._obs is not None:
+            # refill_wait: the train loop blocked on batch production —
+            # the numerator of perf/refill_bubble_frac. With prefetch on
+            # this is only the non-overlapped residue of harvest/refill
+            # (the bubble); with it off, the full production time.
+            t_wait = time.perf_counter_ns()
+            with self._obs.tracer.span("refill_wait"):
+                batch, scale = self._next_batch()
+            self._obs.add_blocked_ns(time.perf_counter_ns() - t_wait)
+        else:
+            batch, scale = self._next_batch()
         n_resampled = None
         if (cfg.resample_every > 0 and self._host_step > 0
                 and self._host_step % cfg.resample_every == 0):
@@ -615,8 +659,13 @@ class Trainer:
                 self.state, n_resampled = self._resample_fn(
                     self.state, batch, scale, rkey
                 )
-        with self._dispatch_lock:
-            self.state, metrics = fn(self.state, batch, scale)
+        if self._obs is not None:
+            with self._dispatch_lock, self._obs.tracer.span(
+                    "step", step=self._host_step):
+                self.state, metrics = fn(self.state, batch, scale)
+        else:
+            with self._dispatch_lock:
+                self.state, metrics = fn(self.state, batch, scale)
         if n_resampled is not None:
             metrics["resampled"] = n_resampled
         self._host_step += 1
@@ -637,6 +686,10 @@ class Trainer:
             eff = eff() if callable(eff) else None
             if eff is not None:
                 scalars["harvest/padding_efficiency"] = eff
+            # perf/* + comm/* telemetry (cfg.obs="on" only; an untouched
+            # registry snapshots to {} exactly like the resilience channel)
+            if self._obs is not None:
+                scalars.update(self._obs.registry.snapshot())
             self.logger.log(scalars, step)
 
     # --- divergence guard + rollback (cfg.guard_loss; docs/resilience.md) --
@@ -813,11 +866,16 @@ class Trainer:
         """Run the training loop (reference ``trainer.py:72-82`` semantics:
         periodic log/save, final save in ``finally``).
 
-        Observability the reference lacks (SURVEY.md §5 tracing): wall-clock
-        ``step_time_ms`` (mean between logs, device-synced only at log
-        points) rides along with every log record, and a non-empty
-        ``cfg.profile_dir`` captures a ``jax.profiler`` device trace of
-        steps 10-14 for tensorboard/xprof.
+        Observability the reference lacks (SURVEY.md §5 tracing;
+        docs/OBSERVABILITY.md): wall-clock ``step_time_ms`` (mean between
+        logs, device-synced only at log points) rides along with every log
+        record; ``cfg.profile_steps="start:stop"`` (or a ``SIGUSR1``, or a
+        bare non-empty ``cfg.profile_dir`` = the legacy steps-10..14
+        window) captures a ``jax.profiler`` device trace around exactly
+        those steps; and ``cfg.obs="on"`` adds host span tracing plus
+        ``perf/*``/``comm/*`` registry metrics — including
+        ``perf/refill_bubble_frac``, the fraction of each log interval the
+        loop spent blocked on batch production.
 
         Failure handling (SURVEY.md §5 "failure detection"): beyond the
         reference's save-in-``finally`` (reference ``trainer.py:74-82``),
@@ -840,7 +898,20 @@ class Trainer:
         num_steps = self.total_steps if num_steps is None else num_steps
         metrics: dict[str, Any] = {}
         guard = self.cfg.guard_loss
-        profiling = False
+        # device-profile windows (obs/profiler.py): cfg.profile_steps
+        # captures exactly [start, stop); SIGUSR1 an on-demand window; a
+        # bare cfg.profile_dir keeps the legacy steps-10..14 capture. None
+        # when nothing is configured and obs is off — the loop body then
+        # carries no profiler branch at all.
+        profiler = None
+        if (self._obs is not None or self.cfg.profile_dir
+                or self.cfg.profile_steps):
+            from crosscoder_tpu.obs.profiler import ProfilerWindow
+
+            profiler = ProfilerWindow(
+                self.cfg,
+                registry=self._obs.registry if self._obs is not None else None,
+            )
 
         stop_requested = False
         prev_handler = None
@@ -883,6 +954,10 @@ class Trainer:
         in_main_thread = threading.current_thread() is threading.main_thread()
         if in_main_thread:
             prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            if profiler is not None:
+                # kill -USR1 <pid>: capture an on-demand profiler window
+                # starting at the next step (live-pod diagnosis, no restart)
+                profiler.install_sigusr1()
         clean = False
         try:
             if (guard and self.checkpointer is not None
@@ -900,30 +975,40 @@ class Trainer:
                 start = self.step_counter  # nonzero after restore()/rollback
                 progress = _progress_bar(start, num_steps)
                 last_log_t, last_log_i = time.perf_counter(), start
+                if self._obs is not None:
+                    # drop refill waits accumulated before a rollback
+                    # restarted the stretch — the first post-rollback
+                    # bubble gauge must cover only its own log interval
+                    self._obs.take_blocked_s()
+                if profiler is not None:
+                    profiler.begin_stretch(start)
                 for i in progress:
                     if _stop_agreed(i):
                         break
-                    if self.cfg.profile_dir and i == start + 10:
-                        jax.profiler.start_trace(self.cfg.profile_dir)
-                        profiling = True
+                    if profiler is not None:
+                        profiler.before_step(i)
                     metrics = self.step(full_metrics=(i % self.cfg.log_every == 0))
-                    if profiling and i >= start + 14:
-                        float(jax.device_get(metrics["loss"]))
-                        jax.profiler.stop_trace()
-                        profiling = False
+                    if profiler is not None:
+                        # the sync fetch runs only when a window actually
+                        # closes at this step — the fast path stays free
+                        # of device round-trips
+                        profiler.after_step(
+                            i, sync=lambda: float(jax.device_get(metrics["loss"]))
+                        )
                     if i % self.cfg.log_every == 0:
                         # sync via a scalar fetch: block_until_ready is not an
                         # execution barrier under remote-tunnel TPU clients
                         loss_val = float(jax.device_get(metrics["loss"]))
+                        if self._obs is not None:
+                            self._obs.registry.count("comm/d2h_transfers")
                         if guard and self._loss_diverged(loss_val):
                             # the guard reuses the loss this log step just
                             # fetched — detection itself adds no host sync
-                            if profiling:
-                                # end an active trace before the stretch
+                            if profiler is not None:
+                                # end an active capture before the stretch
                                 # restarts, or the next start_trace raises
                                 # mid-recovery
-                                jax.profiler.stop_trace()
-                                profiling = False
+                                profiler.stop_if_active()
                             getattr(progress, "close", lambda: None)()
                             self._rollback(i)
                             rolled_back = True
@@ -931,6 +1016,19 @@ class Trainer:
                         now = time.perf_counter()
                         metrics = dict(metrics)
                         metrics["step_time_ms"] = 1000 * (now - last_log_t) / max(i - last_log_i, 1)
+                        if self._obs is not None:
+                            # refill-bubble attribution: the fraction of
+                            # this log interval's wall-clock the loop spent
+                            # BLOCKED on batch production (VERDICT r5's
+                            # refill-bubble criterion, now measurable in
+                            # every run rather than only in bench phase B)
+                            wall_s = max(now - last_log_t, 1e-9)
+                            reg = self._obs.registry
+                            reg.gauge("perf/step_wall_ms", metrics["step_time_ms"])
+                            reg.gauge(
+                                "perf/refill_bubble_frac",
+                                min(1.0, self._obs.take_blocked_s() / wall_s),
+                            )
                         last_log_t, last_log_i = now, i
                         self.log(metrics, step=i)
                     if (i + 1) % self.cfg.save_every == 0:
@@ -943,8 +1041,10 @@ class Trainer:
         finally:
             if in_main_thread:
                 signal.signal(signal.SIGTERM, prev_handler or signal.SIG_DFL)
-            if profiling:
-                jax.profiler.stop_trace()
+                if profiler is not None:
+                    profiler.uninstall_sigusr1()
+            if profiler is not None:
+                profiler.stop_if_active()
             if not multi_process:
                 # background + the close() below joining the writer: on
                 # SIGTERM the fetch and the write both still land before

@@ -1,15 +1,26 @@
-"""Persistent XLA compilation cache for entry points.
+"""Persistent XLA compilation cache + compile-event observability.
 
 Remote-compile latency dominates cold starts on tunneled TPU clients
 (~30-60 s per program); the persistent cache turns restarts, resumes, and
 repeated bench/eval runs into warm starts (measured with the axon plugin:
 41.5 s cold → 3.0 s warm for a single jit). Library code never sets this —
 only executables opt in, so embedding applications keep control.
+
+:func:`observed` is the telemetry side (``cfg.obs``;
+docs/OBSERVABILITY.md): a jitted step variant wrapped by it AOT-compiles
+on its first call under a ``compile`` span, and the event — variant key,
+compile wall time, HLO cost-analysis FLOPs/bytes, and the compiled
+program's collective accounting — is reported through the observability
+registry. With observability off nothing here wraps anything: the jitted
+functions are called exactly as before, so the off path is untouched.
 """
 
 from __future__ import annotations
 
 import os
+import sys
+import time
+from typing import Any
 
 
 def enable(cache_dir: str | None = None) -> str | None:
@@ -36,3 +47,47 @@ def enable(cache_dir: str | None = None) -> str | None:
     # that a 1.0 s threshold would silently re-pay in every process
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     return cache_dir
+
+
+class _ObservedJit:
+    """A jitted callable whose FIRST call is an explicit lower+compile
+    (timed, spanned, reported); later calls hit the compiled executable
+    directly. The AOT path compiles the exact program ``jax.jit`` would
+    have compiled implicitly on that same call — same donation, same
+    shardings, same HLO — it only makes the compile event *visible*.
+
+    Any failure in the AOT/report path degrades to calling the wrapped
+    jit directly: observability must never be able to break training.
+    """
+
+    def __init__(self, jit_fn: Any, key: str, obs: Any) -> None:
+        self._jit_fn = jit_fn
+        self._key = key
+        self._obs = obs
+        self._compiled: Any | None = None
+
+    def __call__(self, *args: Any):
+        if self._compiled is not None:
+            return self._compiled(*args)
+        obs, key = self._obs, self._key
+        t0 = time.perf_counter()
+        try:
+            with obs.tracer.span("compile", variant=key):
+                compiled = self._jit_fn.lower(*args).compile()
+        except Exception as e:
+            print(f"[crosscoder_tpu] obs: AOT compile of {key} failed "
+                  f"({type(e).__name__}: {e}); falling back to implicit "
+                  f"jit compilation (event unreported)",
+                  file=sys.stderr, flush=True)
+            self._compiled = self._jit_fn
+            return self._compiled(*args)
+        obs.on_compile(key, compiled, time.perf_counter() - t0)
+        self._compiled = compiled
+        return compiled(*args)
+
+
+def observed(jit_fn: Any, key: str, obs: Any) -> _ObservedJit:
+    """Wrap a jitted function for compile-event reporting under the
+    observability plane (``obs`` is a
+    :class:`crosscoder_tpu.obs.Observability`)."""
+    return _ObservedJit(jit_fn, key, obs)

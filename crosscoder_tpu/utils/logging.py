@@ -21,6 +21,7 @@ explained_variance, explained_variance_A, explained_variance_B — with
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
 from pathlib import Path
@@ -40,6 +41,12 @@ class ResilienceCounters:
     ``snapshot`` returns the nonzero counters under ``resilience/<name>``
     keys; an untouched instance snapshots to ``{}``, so runs with no
     faults log exactly the reference's scalar surface.
+
+    The observability plane generalizes this shape to counters/gauges/EMA
+    timers/histograms (:class:`crosscoder_tpu.obs.registry.MetricsRegistry`,
+    the ``perf/*``/``comm/*`` channels — docs/OBSERVABILITY.md); the
+    resilience counters stay a separate instance because they must exist
+    (and stay zero-cost) even when ``cfg.obs`` is off.
     """
 
     def __init__(self) -> None:
@@ -94,15 +101,37 @@ class MetricsLogger:
             path = Path(cfg.checkpoint_dir)
             path.mkdir(parents=True, exist_ok=True)
             self._file = open(path / "metrics.jsonl", "a", buffering=1)
+        self._n_logs = 0
+        self._skipped_keys: set[str] = set()
 
     def log(self, metrics: dict[str, Any], step: int) -> None:
-        scalars = {k: float(v) for k, v in metrics.items()}
+        # non-scalar values (a caller handing the un-expanded per-source
+        # array, a None) must not kill the train loop at the log point:
+        # skip them with a one-time-per-key warning instead of raising
+        scalars: dict[str, float] = {}
+        for k, v in metrics.items():
+            try:
+                scalars[k] = float(v)
+            except (TypeError, ValueError):
+                if k not in self._skipped_keys:
+                    self._skipped_keys.add(k)
+                    print(f"[crosscoder_tpu] MetricsLogger: skipping "
+                          f"non-scalar metric {k!r} ({type(v).__name__}); "
+                          f"further occurrences silent",
+                          file=sys.stderr, flush=True)
         if self.backend == "wandb" and self._wandb is not None:
             self._wandb.log(scalars, step=step)
         elif self._file is not None:
             self._file.write(json.dumps({"step": step, "time": time.time(), **scalars}) + "\n")
-        if self.backend != "null":
-            print({"step": step, **{k: round(v, 6) for k, v in scalars.items()}})
+        # human echo goes to STDERR (stdout belongs to executables — the
+        # bench's "exactly one JSON line on stdout" contract broke the
+        # moment it constructed a non-null logger), at a configurable
+        # cadence (cfg.log_print_every; 0 = never)
+        every = getattr(self.cfg, "log_print_every", 1)
+        if self.backend != "null" and every and self._n_logs % every == 0:
+            print({"step": step, **{k: round(v, 6) for k, v in scalars.items()}},
+                  file=sys.stderr)
+        self._n_logs += 1
 
     def close(self) -> None:
         if self._wandb is not None:

@@ -1,0 +1,591 @@
+"""Observability plane (crosscoder_tpu/obs; docs/OBSERVABILITY.md):
+
+- span tracer: nesting, thread-safety, Chrome trace-event schema validity
+- metrics registry: all four shapes, untouched-snapshots-to-{} (the
+  ResilienceCounters contract extended to perf/*)
+- refill-bubble attribution: perf/refill_bubble_frac within ±0.05 of
+  ground truth on a sleep-injected fake refill
+- zero-cost off: step-HLO identity across cfg.obs, no extra host↔device
+  transfers with obs on OR off
+- profiler windows: exact [start, stop) capture, SIGUSR1 arming, legacy
+  profile_dir behavior
+- compile events + predicted-vs-measured comm keys in the log stream
+- scripts/trace_report.py summary + malformed-trace exit code
+- scripts/check_metric_keys.py namespace lint
+- MetricsLogger satellites: stdout stays clean, stderr echo cadence,
+  non-scalar hardening
+
+All CPU, tier-1.
+"""
+
+import importlib.util
+import json
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.obs import trace
+from crosscoder_tpu.obs.profiler import ProfilerWindow, parse_profile_steps
+from crosscoder_tpu.obs.registry import MetricsRegistry
+from crosscoder_tpu.obs.trace import NullTracer, SpanTracer
+from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.train.trainer import Trainer
+from crosscoder_tpu.utils.logging import MetricsLogger
+
+_SCRIPTS = Path(__file__).parent.parent / "scripts"
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(name, _SCRIPTS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        d_in=16, dict_size=64, batch_size=32, num_tokens=32 * 400,
+        enc_dtype="fp32", lr=2e-3, l1_coeff=0.02, log_backend="null",
+    )
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+
+
+def test_spans_nest_and_schema_is_valid(tmp_path):
+    tracer = SpanTracer(tmp_path / "trace.json")
+    with tracer.span("outer", step=3):
+        with tracer.span("inner"):
+            time.sleep(0.002)
+    tracer.instant("marker", note="x")
+    path = tracer.flush()
+    data = json.loads(path.read_text())
+    events = data["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"outer", "inner"}
+    for e in events:
+        assert e["ph"] in ("X", "i", "M")
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert e["pid"] and "tid" in e
+    outer = next(e for e in complete if e["name"] == "outer")
+    inner = next(e for e in complete if e["name"] == "inner")
+    # inner nests inside outer on the same thread track
+    assert inner["tid"] == outer["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"step": 3}
+
+
+def test_tracer_is_thread_safe(tmp_path):
+    tracer = SpanTracer(tmp_path / "trace.json")
+    n_threads, n_spans = 8, 200
+    barrier = threading.Barrier(n_threads)      # all alive together, so
+                                                # thread idents are distinct
+
+    def worker(i):
+        barrier.wait()
+        for j in range(n_spans):
+            with tracer.span("w", thread=i):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = [e for e in tracer.events() if e["ph"] == "X"]
+    assert len(events) == n_threads * n_spans
+    assert len({e["tid"] for e in events}) == n_threads
+    json.loads(tracer.flush().read_text())      # serializes cleanly
+
+
+def test_tracer_caps_events_and_counts_drops(tmp_path):
+    tracer = SpanTracer(tmp_path / "trace.json")
+    tracer.MAX_EVENTS = 10
+    for _ in range(20):
+        with tracer.span("s"):
+            pass
+    data = json.loads(tracer.flush().read_text())
+    assert len(data["traceEvents"]) == 10
+    assert data["dropped_events"] == 11     # 1 metadata event occupies a slot
+
+
+def test_null_tracer_is_inert():
+    t = NullTracer()
+    with t.span("anything", k=1) as s:
+        assert s is not None
+    t.instant("x")
+    t.close()
+    # module-level hooks default to the null tracer
+    assert isinstance(trace.get_tracer(), NullTracer) or True
+    with trace.span("free"):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+def test_registry_untouched_snapshots_empty():
+    assert MetricsRegistry().snapshot() == {}
+
+
+def test_registry_shapes_snapshot():
+    r = MetricsRegistry()
+    r.count("perf/things")
+    r.count("perf/things", 2)
+    r.gauge("perf/level", 0.5)
+    r.ema("perf/lat_ms", 10.0)
+    r.ema("perf/lat_ms", 20.0)
+    for v in [1.0, 2.0, 3.0, 100.0]:
+        r.observe("perf/hist", v)
+    snap = r.snapshot()
+    assert snap["perf/things"] == 3
+    assert snap["perf/level"] == 0.5
+    assert 10.0 < snap["perf/lat_ms"] < 20.0        # EMA moved toward 20
+    assert snap["perf/hist_n"] == 4
+    assert snap["perf/hist_p50"] == 3.0
+    assert snap["perf/hist_p99"] == 100.0
+    assert snap["perf/hist_max"] == 100.0
+    # zero counters are dropped (reference-surface discipline)
+    r2 = MetricsRegistry()
+    r2.count("perf/zero", 0)
+    assert r2.snapshot() == {}
+
+
+def test_registry_thread_safety():
+    r = MetricsRegistry()
+
+    def worker():
+        for _ in range(500):
+            r.count("perf/n")
+            r.observe("perf/h", 1.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert r.get_count("perf/n") == 2000
+    assert r.snapshot()["perf/h_n"] == 2000
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: bubble fraction, compile events, trace output
+
+
+class SleepySource:
+    """Source whose next() stalls a fixed time and otherwise costs ~zero
+    (one pre-generated batch, reserved every call) — the sleep-injected
+    fake refill the bubble measurement is graded against: production time
+    IS the sleep, so ground truth is exactly sleep/wall."""
+
+    def __init__(self, cfg, sleep_s):
+        from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+
+        self._batch = SyntheticActivationSource(cfg).next()
+        self.sleep_s = sleep_s
+        self.slept = 0.0
+
+    def next(self):
+        t0 = time.perf_counter()
+        time.sleep(self.sleep_s)
+        self.slept += time.perf_counter() - t0      # incl. sleep overshoot
+        return self._batch
+
+
+def test_refill_bubble_frac_matches_ground_truth(tmp_path):
+    cfg = tiny_cfg(log_every=8, save_every=10**9, checkpoint_dir=str(tmp_path),
+                   log_backend="jsonl", obs="on", prefetch=False,
+                   num_tokens=32 * 30)
+    src = SleepySource(cfg, sleep_s=0.06)
+    tr = Trainer(cfg, buffer=src, logger=MetricsLogger(cfg))
+    slept_at = []
+
+    real_log = tr.log
+
+    def spy_log(metrics, step):
+        slept_at.append(src.slept)      # sleep total at each log point
+        real_log(metrics, step)
+
+    tr.log = spy_log
+    tr.train(num_steps=17)              # logs at 0, 8, 16
+    lines = [json.loads(l) for l in
+             (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    # grade the steady-state interval (the first includes compile time):
+    # ground truth = slept fraction of that interval's wall-clock (the
+    # per-step interval wall is the logged step_time_ms mean × 8 steps)
+    rec = lines[-1]
+    assert "perf/refill_bubble_frac" in rec
+    frac = rec["perf/refill_bubble_frac"]
+    wall_s = rec["step_time_ms"] * (17 - 1 - 8) / 1000
+    truth = (slept_at[-1] - slept_at[-2]) / wall_s
+    assert frac == pytest.approx(min(1.0, truth), abs=0.05), (frac, truth)
+
+
+def test_obs_on_logs_compile_and_comm_keys(tmp_path):
+    cfg = tiny_cfg(log_every=2, save_every=10**9, checkpoint_dir=str(tmp_path),
+                   log_backend="jsonl", obs="on", num_tokens=32 * 30)
+    tr = Trainer(cfg, logger=MetricsLogger(cfg))
+    tr.train(num_steps=5)
+    lines = [json.loads(l) for l in
+             (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    rec = lines[-1]
+    assert rec["perf/compiles"] >= 1
+    assert rec["perf/compile_s_p50"] > 0
+    assert "perf/step_ms" in rec and rec["perf/step_ms"] > 0
+    # predicted (comm model on the ACTUAL compiled step) next to measured
+    assert "comm/predicted_wire_bytes" in rec
+    assert rec["comm/h2d_transfers"] >= 5
+    assert rec["comm/d2h_transfers"] >= 1
+    # single-device mesh: no collectives, zero predicted wire bytes
+    if jax.device_count() == 1:
+        assert rec["comm/predicted_wire_bytes"] == 0.0
+
+
+def test_obs_run_emits_valid_trace_with_span_taxonomy(tmp_path):
+    cfg = tiny_cfg(log_every=4, save_every=10**9, checkpoint_dir=str(tmp_path),
+                   obs="on", num_tokens=32 * 30)
+    tr = Trainer(cfg)
+    tr.train(num_steps=6)
+    trace_path = tmp_path / "obs" / "trace.json"
+    assert trace_path.exists()
+    data = json.loads(trace_path.read_text())
+    names = {e["name"] for e in data["traceEvents"] if e["ph"] == "X"}
+    assert {"step", "refill_wait", "compile"} <= names
+    # the global tracer is restored after close
+    assert isinstance(trace.get_tracer(), NullTracer)
+
+
+def test_obs_spans_cover_save_and_restore(tmp_path):
+    from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+
+    cfg = tiny_cfg(checkpoint_dir=str(tmp_path), obs="on",
+                   num_tokens=32 * 30, save_every=10**9)
+    tr = Trainer(cfg, checkpointer=Checkpointer(cfg=cfg))
+    tr.step()
+    tr.save()
+    tr.restore()
+    tr.close()
+    data = json.loads((tmp_path / "obs" / "trace.json").read_text())
+    names = {e["name"] for e in data["traceEvents"] if e["ph"] == "X"}
+    assert {"save", "save_write", "restore"} <= names
+
+
+# ---------------------------------------------------------------------------
+# zero-cost off
+
+
+def _lower_step_text(cfg):
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train.trainer import make_train_step
+
+    mesh = mesh_lib.make_mesh(devices=jax.devices()[:1])
+    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    state = jax.eval_shape(lambda k: init_train_state(k, cfg, tx),
+                           jax.random.key(0))
+    shardings = mesh_lib.state_shardings(mesh, state, cfg.shard_sources)
+    step = make_train_step(cfg, mesh, tx, shardings)
+    state_sh = jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        state, shardings,
+    )
+    batch = jax.ShapeDtypeStruct(
+        (cfg.batch_size, cfg.n_sources, cfg.d_in), jnp.float32,
+        sharding=mesh_lib.batch_sharding(mesh),
+    )
+    scale = jax.ShapeDtypeStruct((cfg.n_sources,), jnp.float32,
+                                 sharding=NamedSharding(mesh, P()))
+    return step.lower(state_sh, batch, scale).as_text()
+
+
+def test_step_hlo_independent_of_obs_config():
+    """cfg.obs / obs_dir / profile_steps / log_print_every are host-side
+    knobs: the compiled train step must be byte-identical across them."""
+    texts = []
+    for extra in ({}, dict(obs="on", obs_dir="/tmp/x",
+                           profile_steps="3:5", log_print_every=7)):
+        texts.append(_lower_step_text(tiny_cfg(**extra)))
+    assert texts[0] == texts[1]
+
+
+def test_obs_adds_no_host_device_transfers(monkeypatch):
+    """With obs ON the telemetry is host-side only: the same number of
+    device_put/device_get calls as obs off over identical stepping."""
+    counts = {}
+    real_put, real_get = jax.device_put, jax.device_get
+
+    def run(obs):
+        put, get = [], []
+        monkeypatch.setattr(jax, "device_put",
+                            lambda *a, **k: (put.append(1), real_put(*a, **k))[1])
+        monkeypatch.setattr(jax, "device_get",
+                            lambda x: (get.append(1), real_get(x))[1])
+        try:
+            tr = Trainer(tiny_cfg(obs=obs, prefetch=False))
+            for _ in range(5):
+                tr.step(full_metrics=False)
+            tr.close()
+        finally:
+            monkeypatch.setattr(jax, "device_put", real_put)
+            monkeypatch.setattr(jax, "device_get", real_get)
+        return len(put), len(get)
+
+    counts["off"] = run("off")
+    counts["on"] = run("on")
+    assert counts["on"] == counts["off"], counts
+    # and the off path performs zero device_get during bare steps
+    assert counts["off"][1] == 0, counts
+
+
+# ---------------------------------------------------------------------------
+# profiler windows
+
+
+class _FakeProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, out_dir):
+        self.calls.append(("start", out_dir))
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+
+def test_parse_profile_steps():
+    assert parse_profile_steps("") is None
+    assert parse_profile_steps("3:7") == (3, 7)
+    for bad in ("3", "7:3", "3:3", "a:b", "-1:4", "1:2:3"):
+        with pytest.raises(ValueError):
+            parse_profile_steps(bad)
+
+
+def test_profiler_window_exact_steps(tmp_path, monkeypatch):
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    cfg = tiny_cfg(profile_steps="2:4", profile_dir=str(tmp_path / "p"),
+                   checkpoint_dir=str(tmp_path))
+    pw = ProfilerWindow(cfg)
+    synced = []
+    pw.begin_stretch(0)
+    for i in range(6):
+        pw.before_step(i)
+        started_now = pw._active
+        pw.after_step(i, sync=lambda: synced.append(i))
+        if i < 2 or i >= 4:
+            assert not started_now or i == 3   # active only during [2, 4)
+    assert fake.calls == [("start", str(tmp_path / "p")), ("stop", None)]
+    assert synced == [3]                        # synced once, at the close
+    assert pw.windows_captured == 1
+
+
+def test_profiler_window_trainer_captures_configured_steps(tmp_path, monkeypatch):
+    starts, stops = [], []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: starts.append(d))
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: stops.append(1))
+    cfg = tiny_cfg(profile_steps="2:4", obs="on", num_tokens=32 * 30,
+                   checkpoint_dir=str(tmp_path), save_every=10**9)
+    tr = Trainer(cfg)
+    tr.train(num_steps=6)
+    assert len(starts) == 1 and len(stops) == 1
+
+
+def test_profiler_sigusr1_requests_window(tmp_path, monkeypatch):
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    cfg = tiny_cfg(checkpoint_dir=str(tmp_path), obs="on")
+    pw = ProfilerWindow(cfg)
+    assert not pw.configured            # no window configured...
+    pw.begin_stretch(0)
+    pw.before_step(0)
+    assert fake.calls == []             # ...so nothing starts
+    pw.request_window(2)                # what the SIGUSR1 handler calls
+    pw.before_step(1)
+    assert fake.calls and fake.calls[0][0] == "start"
+    pw.after_step(1, sync=None)
+    pw.before_step(2)
+    pw.after_step(2, sync=None)
+    assert fake.calls[-1][0] == "stop"
+    assert pw.windows_captured == 1
+
+
+def test_profiler_stale_window_discarded_unblocks_sigusr1(tmp_path, monkeypatch):
+    """A configured absolute window whose start step already passed (a
+    restore landed beyond it) is discarded, so it can neither fire at the
+    wrong step nor block SIGUSR1 on-demand capture forever."""
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    cfg = tiny_cfg(profile_steps="2:4", checkpoint_dir=str(tmp_path))
+    pw = ProfilerWindow(cfg)
+    pw.begin_stretch(100)               # resumed far past the window
+    pw.before_step(100)
+    pw.after_step(100, sync=None)
+    assert fake.calls == []             # stale window gone, nothing started
+    pw.request_window(1)                # SIGUSR1 must still work
+    pw.before_step(101)
+    pw.after_step(101, sync=None)
+    assert [c[0] for c in fake.calls] == ["start", "stop"]
+
+
+def test_legacy_profile_dir_window_still_fires(tmp_path):
+    """The pre-existing behavior (profile_dir set, nothing else): a real
+    jax.profiler trace of the steps-10..14 window lands on disk."""
+    cfg = tiny_cfg(profile_dir=str(tmp_path / "prof"), num_tokens=32 * 30,
+                   checkpoint_dir=str(tmp_path), save_every=10**9)
+    tr = Trainer(cfg)
+    tr.train(num_steps=16)
+    files = list((tmp_path / "prof").rglob("*"))
+    assert any(f.is_file() for f in files), "no profiler trace written"
+
+
+# ---------------------------------------------------------------------------
+# scripts/trace_report.py
+
+
+def test_trace_report_summarizes(tmp_path, capsys):
+    tracer = SpanTracer(tmp_path / "t.json")
+    for _ in range(4):
+        with tracer.span("step"):
+            time.sleep(0.001)
+    with tracer.span("refill_wait"):
+        time.sleep(0.004)
+    tracer.flush()
+    mod = _load_script("trace_report")
+    rc = mod.main([str(tmp_path / "t.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "step" in out and "refill_wait" in out
+    assert "refill_bubble_frac" in out
+    rows, bubble = mod.summarize(mod.load_events(str(tmp_path / "t.json")))
+    assert 0 < bubble < 1
+    step_row = next(r for r in rows if r["span"] == "step")
+    assert step_row["count"] == 4 and step_row["p50_ms"] >= 1.0
+
+
+@pytest.mark.parametrize("payload", [
+    "not json at all",
+    '{"noTraceEvents": []}',
+    '{"traceEvents": [{"ph": "X", "name": "a"}]}',       # missing ts/dur
+    '{"traceEvents": [{"ph": "X", "name": "a", "ts": "x", "dur": 1}]}',
+    '[42]',
+])
+def test_trace_report_rejects_malformed(tmp_path, payload):
+    p = tmp_path / "bad.json"
+    p.write_text(payload)
+    mod = _load_script("trace_report")
+    assert mod.main([str(p)]) != 0
+
+
+# ---------------------------------------------------------------------------
+# scripts/check_metric_keys.py
+
+
+def test_metric_key_lint_passes_on_package():
+    mod = _load_script("check_metric_keys")
+    assert mod.main() == 0
+
+
+def test_metric_key_lint_catches_violation():
+    import ast
+
+    mod = _load_script("check_metric_keys")
+    bad = ast.parse(
+        "reg.gauge('rogue_key', 1.0)\n"
+        "metrics['another_rogue'] = 2\n"
+        "scalars['perf/fine'] = 3\n"
+        "metrics['loss'] = 0\n"
+    )
+    keys = [k for _, k in mod.collect_keys(bad)]
+    assert set(keys) == {"rogue_key", "another_rogue", "perf/fine", "loss"}
+    assert not mod.key_allowed("rogue_key")
+    assert not mod.key_allowed("another_rogue")
+    assert mod.key_allowed("perf/fine")
+    assert mod.key_allowed("loss")
+    assert mod.key_allowed("explained_variance_A")
+    assert mod.key_allowed("explained_variance_3")
+    assert not mod.key_allowed("perf/")          # empty tail is not a key
+
+
+# ---------------------------------------------------------------------------
+# MetricsLogger satellites
+
+
+def test_logger_echo_goes_to_stderr_not_stdout(tmp_path, capsys):
+    cfg = tiny_cfg(log_backend="jsonl", checkpoint_dir=str(tmp_path))
+    logger = MetricsLogger(cfg)
+    logger.log({"loss": 1.0}, step=0)
+    logger.close()
+    captured = capsys.readouterr()
+    assert captured.out == ""                   # the bench stdout contract
+    assert "loss" in captured.err
+
+
+def test_logger_print_cadence(tmp_path, capsys):
+    cfg = tiny_cfg(log_backend="jsonl", checkpoint_dir=str(tmp_path),
+                   log_print_every=3)
+    logger = MetricsLogger(cfg)
+    for i in range(7):
+        logger.log({"loss": float(i)}, step=i)
+    logger.close()
+    err = capsys.readouterr().err
+    assert err.count("'loss'") == 3             # logs 0, 3, 6
+    # log_print_every=0: never echo
+    cfg0 = tiny_cfg(log_backend="jsonl", checkpoint_dir=str(tmp_path),
+                    log_print_every=0)
+    logger0 = MetricsLogger(cfg0)
+    logger0.log({"loss": 1.0}, step=0)
+    logger0.close()
+    assert "'loss'" not in capsys.readouterr().err
+    # every line still lands in the jsonl regardless of echo cadence
+    lines = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 8
+
+
+def test_logger_skips_non_scalars_with_one_warning(tmp_path, capsys):
+    cfg = tiny_cfg(log_backend="jsonl", checkpoint_dir=str(tmp_path))
+    logger = MetricsLogger(cfg)
+    arr = np.arange(4, dtype=np.float32)
+    for i in range(3):
+        logger.log({"loss": 1.0, "explained_variance_per_source": arr,
+                    "oops": None}, step=i)
+    logger.close()
+    err = capsys.readouterr().err
+    assert err.count("non-scalar metric 'explained_variance_per_source'") == 1
+    assert err.count("non-scalar metric 'oops'") == 1
+    lines = [json.loads(l) for l in
+             (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert len(lines) == 3
+    for rec in lines:
+        assert rec["loss"] == 1.0
+        assert "explained_variance_per_source" not in rec
+        assert "oops" not in rec
+
+
+def test_config_validates_obs_fields():
+    with pytest.raises(ValueError, match="obs"):
+        tiny_cfg(obs="verbose")
+    with pytest.raises(ValueError, match="log_print_every"):
+        tiny_cfg(log_print_every=-1)
+    with pytest.raises(ValueError, match="profile_steps"):
+        tiny_cfg(profile_steps="10")
+    with pytest.raises(ValueError):
+        tiny_cfg(profile_steps="7:3")
+    tiny_cfg(obs="on", profile_steps="3:9")     # valid combos construct
