@@ -310,6 +310,42 @@ def _kernel_parity(dict_size: int) -> dict:
     return entry
 
 
+def _encoder_hbm_bytes(cfg) -> dict:
+    """Predicted step HBM traffic, fused vs dense encoder — the PR 5
+    compile-span HLO cost analysis ("bytes accessed" of the compiled
+    bare model loss+grad) applied to the A/B the fused megakernel
+    claims: same FLOPs, [B, dict] pre-acts never round-tripping HBM.
+    Reported beside wall time so the bytes win is first-class in BENCH
+    output, not an inference from step_ms."""
+    from crosscoder_tpu.models import crosscoder as cc
+
+    def bytes_of(c) -> float:
+        # abstract operands only: .lower() accepts ShapeDtypeStruct
+        # pytrees, and a real 2^17-dict param set would add GBs of HBM
+        # pressure right after the timed leg ran
+        params = jax.eval_shape(lambda key: cc.init_params(key, c),
+                                jax.random.key(0))
+        x = jax.ShapeDtypeStruct(
+            (c.batch_size, c.n_sources, c.d_in), jnp.bfloat16)
+
+        def loss(p, xb):
+            return cc.training_loss(p, xb, 0.0, c, with_metrics=False)[0]
+
+        compiled = jax.jit(jax.grad(loss)).lower(params, x).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):        # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        return float(cost.get("bytes accessed", 0.0))
+
+    fused_b = bytes_of(cfg)
+    dense_b = bytes_of(cfg.replace(fused_encoder="off",
+                                   quant_encoder=False))
+    out = {"hbm_bytes_fused": fused_b, "hbm_bytes_dense": dense_b}
+    if dense_b > 0:
+        out["hbm_bytes_ratio"] = round(fused_b / dense_b, 4)
+    return out
+
+
 def section_matrix() -> list[dict]:
     """The sparse tier, at the training-step level (VERDICT round-1: the
     in-code perf claims were unverifiable; BASELINE config 2 had no
@@ -340,6 +376,25 @@ def section_matrix() -> list[dict]:
          dict(activation="topk", topk_k=32, l1_coeff=0.0, sparse_bwd="on",
               factored_decode="on"),
          "pallas", {"CROSSCODER_SPARSE_GRAD_PALLAS": "1"}),
+        # the fused encoder→TopK megakernel (PR "melt the dense floor"):
+        # identical math to topk_sparse_bwd with the encode+TopK+sparsify
+        # chain fused so [B, dict] pre-acts never hit HBM — step_ms vs
+        # topk_sparse_bwd and vs relu is the headline (ROADMAP item-2
+        # target: TopK <= 1.0x ReLU at dict 2^16/2^17); the
+        # encoder_hbm_* fields carry the HLO cost-analysis bytes A/B
+        ("topk_fused",
+         dict(activation="topk", topk_k=32, l1_coeff=0.0, sparse_bwd="on",
+              factored_decode="on", fused_encoder="on"),
+         "pallas", {"CROSSCODER_SPARSE_GRAD_PALLAS": "1",
+                    "CROSSCODER_FUSED_TOPK_PALLAS": "1"}),
+        # + the int8 block-scaled in-kernel encoder matmul (the
+        # --quant-encoder quality gate rides this leg: selection
+        # agreement vs the exact fused leg)
+        ("topk_fused_int8",
+         dict(activation="topk", topk_k=32, l1_coeff=0.0, sparse_bwd="on",
+              factored_decode="on", fused_encoder="on", quant_encoder=True),
+         "pallas", {"CROSSCODER_SPARSE_GRAD_PALLAS": "1",
+                    "CROSSCODER_FUSED_TOPK_PALLAS": "1"}),
         ("batchtopk", dict(activation="batchtopk", topk_k=32, l1_coeff=0.0),
          "auto", {}),
         # BatchTopK through the chunked Pallas global-threshold kernels
@@ -348,6 +403,13 @@ def section_matrix() -> list[dict]:
         ("batchtopk_pallas",
          dict(activation="batchtopk", topk_k=32, l1_coeff=0.0),
          "auto", {"CROSSCODER_BATCHTOPK_PALLAS": "1"}),
+        # fused BatchTopK: global bisection + emit recomputed over
+        # streamed encoder tiles (FLOPs ~3x the single matmul, HBM bytes
+        # ~1 masked write instead of ~7 [B, dict] round-trips)
+        ("batchtopk_fused",
+         dict(activation="batchtopk", topk_k=32, l1_coeff=0.0,
+              fused_encoder="on"),
+         "auto", {"CROSSCODER_FUSED_TOPK_PALLAS": "1"}),
         ("jumprelu", dict(activation="jumprelu", l1_coeff=0.0), "auto", {}),
         # AuxK step cost: aux_dead_steps=1 keeps the dead set non-empty so
         # aux-on steps include the full aux path (approx_max_k ranking
@@ -389,7 +451,8 @@ def section_matrix() -> list[dict]:
     # extra programs per entry, so only where the split answers a
     # question: the sparse-backward A/B pair and the dense floor)
     split_fwd_bwd = {"topk_pallas", "topk_sparse_bwd", "jumprelu",
-                     "batchtopk", "batchtopk_pallas"}
+                     "batchtopk", "batchtopk_pallas", "topk_fused",
+                     "topk_fused_int8", "batchtopk_fused"}
     steps = int(os.environ.get("BENCH_MATRIX_STEPS", 16))
     dicts = tuple(
         int(x) for x in os.environ.get(
@@ -445,6 +508,19 @@ def section_matrix() -> list[dict]:
                                 "skipped": "batchtopk kernel unsupported at "
                                            "this width"})
                     continue
+            if cfg.fused_encoder == "on":
+                # forced-fused legs must actually time the megakernel,
+                # not its dense fallback
+                from crosscoder_tpu.ops import fused_encoder_topk as fek
+
+                qb = cfg.quant_block if cfg.quant_encoder else 0
+                if not fek.supported(cfg.batch_size,
+                                     cfg.n_sources * cfg.d_in, dict_size,
+                                     cfg.topk_k, jnp.bfloat16, qb):
+                    out.append({"variant": label, "dict_size": dict_size,
+                                "skipped": "fused kernel unsupported at "
+                                           "this shape"})
+                    continue
             act_ops.set_topk_impl(impl)
             try:
                 with _env(env):
@@ -452,6 +528,12 @@ def section_matrix() -> list[dict]:
                     entry = {"variant": label, "dict_size": dict_size, **r}
                     if label in split_fwd_bwd:
                         entry.update(bench_fwd_bwd(cfg, steps))
+                    if cfg.fused_encoder == "on":
+                        try:
+                            entry.update(_encoder_hbm_bytes(cfg))
+                        except Exception as e:   # cost analysis is best-effort
+                            entry["hbm_bytes_error"] = (
+                                f"{type(e).__name__}: {str(e)[:120]}")
             except Exception as e:     # one OOM must not kill the bench
                 entry = {"variant": label, "dict_size": dict_size,
                          "error": f"{type(e).__name__}: {str(e)[:200]}"}

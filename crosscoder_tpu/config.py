@@ -29,6 +29,22 @@ from typing import Any
 
 from crosscoder_tpu.utils.dtypes import DTYPES
 
+
+def _check_choice(field_name: str, value: Any,
+                  choices: tuple[str, ...]) -> None:
+    """Membership check for a string mode knob, with a difflib typo
+    hint — every choice knob validates through here so the error shape
+    lives in one place instead of a copy per knob."""
+    if value in choices:
+        return
+    import difflib
+
+    close = difflib.get_close_matches(str(value), choices, n=1)
+    hint = f"; did you mean {close[0]!r}?" if close else ""
+    raise ValueError(
+        f"{field_name} must be {'|'.join(choices)}, got {value!r}{hint}"
+    )
+
 # dtype strings follow the reference's DTYPES table (reference crosscoder.py:12)
 DTYPE_NAMES = tuple(DTYPES)
 
@@ -106,6 +122,38 @@ class CrossCoderConfig:
                                     # forces the factored tier); "off"
                                     # never. Requires l1_coeff == 0 (the
                                     # factored tier's soundness gate).
+    fused_encoder: str = "auto"     # fused encoder→TopK megakernel
+                                    # (ops/fused_encoder_topk.py;
+                                    # docs/SCALING.md "Fused encoder→
+                                    # TopK"): the encoder matmul streams
+                                    # dictionary tiles through VMEM and
+                                    # top-k-reduces them in-kernel, so
+                                    # the [B, dict] pre-act matrix never
+                                    # round-trips HBM. topk: rides the
+                                    # sparse-backward full-step scope
+                                    # (requires factored tier + sparse_bwd
+                                    # live; AuxK steps keep the dense
+                                    # encode — the h-residual escape
+                                    # hatch). batchtopk: fused global-
+                                    # bisection count-then-emit. "auto" =
+                                    # on when the kernel is live (TPU +
+                                    # CROSSCODER_FUSED_TOPK_PALLAS=1 or
+                                    # CROSSCODER_PALLAS=all, or interpret
+                                    # mode) and shapes are supported;
+                                    # "on"/"off" force. Zero-cost off
+                                    # (step-HLO identity).
+    quant_encoder: bool = False     # fused tier only: int8 block-scaled
+                                    # encoder matmul inside the fused
+                                    # kernel (per-block scales along the
+                                    # contraction axis, ops/quant.py
+                                    # layout) — ~0.5x weight-stream
+                                    # bytes at a small selection-
+                                    # agreement cost. Opt-in behind the
+                                    # bench quality gate (the
+                                    # --quant-grads discipline):
+                                    # docs/SCALING.md has the procedure.
+                                    # quant_block must divide
+                                    # n_sources·d_in.
     jumprelu_theta: float = 0.001   # initial JumpReLU threshold
     jumprelu_bandwidth: float = 0.001  # STE bandwidth for the threshold gradient
     l0_coeff: float = 0.0           # jumprelu only: coefficient on the
@@ -431,17 +479,8 @@ class CrossCoderConfig:
             raise ValueError(
                 f"seq_shards {self.seq_shards} must divide seq_len {self.seq_len}"
             )
-        if self.harvest_runtime not in ("padded", "paged"):
-            import difflib
-
-            close = difflib.get_close_matches(
-                str(self.harvest_runtime), ("padded", "paged"), n=1
-            )
-            hint = f"; did you mean {close[0]!r}?" if close else ""
-            raise ValueError(
-                f"harvest_runtime must be padded|paged, got "
-                f"{self.harvest_runtime!r}{hint}"
-            )
+        _check_choice("harvest_runtime", self.harvest_runtime,
+                      ("padded", "paged"))
         if self.page_size < 1 or self.page_size & (self.page_size - 1):
             below = 1 << max(0, self.page_size.bit_length() - 1)
             raise ValueError(
@@ -477,10 +516,8 @@ class CrossCoderConfig:
             raise ValueError(
                 f"sparse_decode requires activation='topk', got {self.activation!r}"
             )
-        if self.factored_decode not in ("auto", "on", "off"):
-            raise ValueError(
-                f"factored_decode must be auto|on|off, got {self.factored_decode!r}"
-            )
+        _check_choice("factored_decode", self.factored_decode,
+                      ("auto", "on", "off"))
         if self.factored_decode == "on" and self.activation != "topk":
             raise ValueError(
                 f"factored_decode='on' requires activation='topk', "
@@ -492,16 +529,7 @@ class CrossCoderConfig:
                 "forward's custom VJP carries no gradient path through "
                 "(vals, idx), which a nonzero weighted-L1 objective needs"
             )
-        if self.sparse_bwd not in ("auto", "on", "off"):
-            import difflib
-
-            close = difflib.get_close_matches(
-                str(self.sparse_bwd), ("auto", "on", "off"), n=1
-            )
-            hint = f"; did you mean {close[0]!r}?" if close else ""
-            raise ValueError(
-                f"sparse_bwd must be auto|on|off, got {self.sparse_bwd!r}{hint}"
-            )
+        _check_choice("sparse_bwd", self.sparse_bwd, ("auto", "on", "off"))
         if self.sparse_bwd == "on" and self.activation != "topk":
             raise ValueError(
                 f"sparse_bwd='on' requires activation='topk' (the sparse "
@@ -521,6 +549,62 @@ class CrossCoderConfig:
                 "sparse backward extends the factored Pallas tier, not the "
                 "legacy gather decode (which has its own custom VJP)"
             )
+        _check_choice("fused_encoder", self.fused_encoder,
+                      ("auto", "on", "off"))
+        if self.fused_encoder == "on":
+            if self.activation not in ("topk", "batchtopk"):
+                raise ValueError(
+                    f"fused_encoder='on' requires activation='topk' or "
+                    f"'batchtopk' (the kernel IS a fused TopK/BatchTopK "
+                    f"selection), got {self.activation!r}"
+                )
+            if self.activation == "topk":
+                if self.sparse_bwd == "off":
+                    raise ValueError(
+                        "fused_encoder='on' with activation='topk' requires "
+                        "sparse_bwd != 'off': the fused forward hands "
+                        "(vals, idx) to the sparse backward plane — without "
+                        "it the backward would need the dense pre-acts the "
+                        "fusion exists to never materialize"
+                    )
+                if self.l1_coeff != 0:
+                    raise ValueError(
+                        "fused_encoder='on' with activation='topk' requires "
+                        "l1_coeff=0 (the factored/sparse tier it rides "
+                        "carries no gradient path through (vals, idx))"
+                    )
+                if self.sparse_decode:
+                    raise ValueError(
+                        "fused_encoder='on' is incompatible with "
+                        "sparse_decode: the fused tier extends the factored "
+                        "Pallas tier, not the legacy gather decode"
+                    )
+        if self.quant_encoder:
+            if self.fused_encoder == "off":
+                raise ValueError(
+                    "quant_encoder requires fused_encoder != 'off': the "
+                    "int8 block-scaled matmul lives INSIDE the fused "
+                    "kernel; with the fused tier off the knob would "
+                    "silently do nothing"
+                )
+            if self.activation != "topk":
+                raise ValueError(
+                    f"quant_encoder requires activation='topk': the int8 "
+                    f"path lives in the fused TopK kernel only (BatchTopK "
+                    f"stacks quantization error into a GLOBAL order "
+                    f"statistic and stays exact), got {self.activation!r}"
+                )
+            nd = self.n_sources * self.d_in
+            if self.quant_block % 128 or nd % self.quant_block:
+                divisors = [b for b in (128, 256, 384, 512)
+                            if nd % b == 0]
+                raise ValueError(
+                    f"quant_encoder: quant_block {self.quant_block} must be "
+                    f"a multiple of 128 dividing n_sources*d_in = {nd} (the "
+                    f"in-kernel int8 dot slices the contraction axis per "
+                    f"block); try one of "
+                    f"{divisors or 'a lane-aligned divisor'}"
+                )
         if self.l0_coeff > 0 and self.activation != "jumprelu":
             raise ValueError(
                 f"l0_coeff requires activation='jumprelu' (the rectangle-"
@@ -603,8 +687,7 @@ class CrossCoderConfig:
                 "the quantized step computes per-device losses, but "
                 "batchtopk's threshold is a GLOBAL-batch order statistic"
             )
-        if self.obs not in ("off", "on"):
-            raise ValueError(f"obs must be off|on, got {self.obs!r}")
+        _check_choice("obs", self.obs, ("off", "on"))
         if self.log_print_every < 0:
             raise ValueError(
                 f"log_print_every must be >= 0 (0 = never echo), got "

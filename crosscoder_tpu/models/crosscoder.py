@@ -447,6 +447,178 @@ def _sparse_topk_step_bwd(k, res, g):
 _sparse_topk_step.defvjp(_sparse_topk_step_fwd, _sparse_topk_step_bwd)
 
 
+# ---------------------------------------------------------------------------
+# fused encoder→TopK tier (cfg.fused_encoder; ops/fused_encoder_topk.py):
+# the _sparse_topk_step forward with the dense encode + TopK + sparsify
+# chain replaced by ONE Pallas kernel that streams encoder tiles through
+# VMEM and folds them into a running per-row top-k — the [B, H] pre-act
+# matrix never exists in HBM. The BACKWARD is _sparse_topk_step's
+# verbatim: its residuals are (x, vals, idx, W_enc, W_dec), none of which
+# the fusion removes, so the two tiers share one bwd implementation and
+# the (vals, idx) contract is pinned by construction. AuxK steps need the
+# pre-acts as a differentiable residual for the aux ranking — the
+# ``h``-residual escape hatch: they stay on _sparse_topk_from_h's dense
+# encode (see get_losses).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_topk_step(
+    x: jax.Array, W_enc: jax.Array, b_enc: jax.Array, W_dec: jax.Array,
+    k: int, quant_block: int = 0,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """``(recon [B,n,d] f32 (no b_dec), vals [B,k], idx [B,k])`` with the
+    encode+TopK+sparsify chain fused into one kernel (``quant_block`` > 0
+    routes the in-kernel int8 block-scaled matmul — cfg.quant_encoder)."""
+    from crosscoder_tpu.ops import fused_encoder_topk as fek
+
+    B = x.shape[0]
+    vals, idx = fek.fused_topk_encode(
+        x.reshape(B, -1), W_enc.reshape(-1, W_enc.shape[-1]), b_enc, k,
+        quant_block=quant_block,
+    )
+    w = jnp.take(W_dec, idx, axis=0)                       # [B, k, n, d]
+    recon = jnp.einsum("bk,bknd->bnd", vals, w,
+                       preferred_element_type=jnp.float32)
+    return recon, vals, idx
+
+
+def _fused_topk_step_fwd(x, W_enc, b_enc, W_dec, k, quant_block):
+    out = _fused_topk_step(x, W_enc, b_enc, W_dec, k, quant_block)
+    _, vals, idx = out
+    # the _sparse_topk_step residual tuple exactly (see its fwd)
+    return out, (x, vals, idx, W_enc, W_dec, jnp.zeros((0,), b_enc.dtype))
+
+
+def _fused_topk_step_bwd(k, quant_block, res, g):
+    # gradients are the sparse plane's verbatim: the kernel only changed
+    # how (vals, idx) were PRODUCED, not what they mean
+    return _sparse_topk_step_bwd(k, res, g)
+
+
+_fused_topk_step.defvjp(_fused_topk_step_fwd, _fused_topk_step_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_batchtopk_encode(
+    x: jax.Array, W_enc: jax.Array, b_enc: jax.Array, k: int
+) -> jax.Array:
+    """Masked BatchTopK activations ``f [B, H]`` with the encoder matmul
+    and the global-threshold bisection fused over streamed tiles
+    (ops/fused_encoder_topk.fused_batchtopk_encode_raw) — bit-identical
+    to ``activations.batchtopk(pre_acts(params, x), k)``. The custom VJP
+    reproduces the dense path's gradients exactly: straight-through on
+    the survivors, then the ordinary encoder-einsum VJP."""
+    from crosscoder_tpu.ops import fused_encoder_topk as fek
+
+    B = x.shape[0]
+    return fek.fused_batchtopk_encode_raw(
+        x.reshape(B, -1), W_enc.reshape(-1, W_enc.shape[-1]), b_enc, k,
+    )
+
+
+def _fused_batchtopk_encode_fwd(x, W_enc, b_enc, k):
+    f = _fused_batchtopk_encode(x, W_enc, b_enc, k)
+    return f, (x, W_enc, f, jnp.zeros((0,), b_enc.dtype))
+
+
+def _fused_batchtopk_encode_bwd(k, res, g):
+    x, W_enc, f, b_tok = res
+    # dense chain: f = hp·stop_grad(mask) → dh = g·mask (mask ⟺ f > 0);
+    # h = (hf + b).astype(x.dtype) → dhf = dh in f32; then the einsum VJP
+    dh = jnp.where(f > 0, g, 0).astype(jnp.float32)        # [B, H]
+    db_enc = jnp.sum(dh, axis=0).astype(b_tok.dtype)
+    dW_enc = jnp.einsum(
+        "bnd,bh->ndh", x.astype(jnp.float32), dh,
+        preferred_element_type=jnp.float32,
+    ).astype(W_enc.dtype)
+    dx = jnp.einsum(
+        "bh,ndh->bnd", dh, W_enc.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    return dx, dW_enc, db_enc
+
+
+_fused_batchtopk_encode.defvjp(_fused_batchtopk_encode_fwd,
+                               _fused_batchtopk_encode_bwd)
+
+
+_FUSED_DEMOTION_WARNED: set[str] = set()
+
+
+def _warn_fused_demoted(reason: str) -> None:
+    """``fused_encoder='on'`` fell back to the dense encode — the silent
+    no-op class the dispatch layer exists to prevent, so say it once per
+    (process, reason) on stderr. Config validation can't catch these:
+    they depend on env/backend resolution ("auto" knobs) only known at
+    trace time."""
+    if reason in _FUSED_DEMOTION_WARNED:
+        return
+    _FUSED_DEMOTION_WARNED.add(reason)
+    import sys
+
+    print(
+        f"[crosscoder_tpu] fused_encoder='on' demoted to the dense "
+        f"encode: {reason}",
+        file=sys.stderr, flush=True,
+    )
+
+
+def use_fused_encoder(cfg: CrossCoderConfig, batch: int | None = None) -> bool:
+    """Dispatch for the fused encoder→TopK tier (``cfg.fused_encoder``).
+
+    "off" never. For ``topk`` the fused forward hands (vals, idx)
+    straight to the sparse backward plane, so it rides the
+    ``_sparse_topk_step`` scope: the factored tier AND
+    :func:`use_sparse_bwd` must be live (AuxK steps additionally fall
+    back at the trace site — the ``h``-residual escape hatch). For
+    ``batchtopk`` it needs only training mode (a calibrated fixed
+    threshold is eval — the emit sweep alone, no bisection to fuse).
+    "auto" additionally requires the kernel to be live (TPU +
+    ``CROSSCODER_FUSED_TOPK_PALLAS=1`` / umbrella, or interpret mode)
+    and a kernel-supported shape; "on" forces, with the ops layer's
+    dense fallback covering unsupported shapes. An "on" that a
+    prerequisite tier demotes anyway (e.g. ``sparse_bwd='auto'``
+    resolving off) warns once on stderr instead of silently no-opping.
+    """
+    if cfg.fused_encoder == "off":
+        return False
+    forced = cfg.fused_encoder == "on"
+    if cfg.activation == "topk":
+        if not (use_factored_decode(cfg) and use_sparse_bwd(cfg, batch)):
+            if forced:
+                _warn_fused_demoted(
+                    "activation='topk' needs the factored tier and the "
+                    "sparse backward plane live (use_factored_decode/"
+                    "use_sparse_bwd resolved off — check dict_size, "
+                    "batch divisibility, and the sparse_grad kernel gate)"
+                )
+            return False
+    elif cfg.activation == "batchtopk":
+        if cfg.batchtopk_threshold > 0:
+            if forced:
+                _warn_fused_demoted(
+                    "batchtopk_threshold > 0 is eval mode — a calibrated "
+                    "fixed threshold has no bisection to fuse"
+                )
+            return False
+    else:
+        return False
+    if cfg.fused_encoder == "on":
+        return True
+    from crosscoder_tpu.ops import fused_encoder_topk as fek
+
+    if not fek.kernel_enabled():
+        return False
+    # the int8 path is topk-only (validated in config) — batchtopk's
+    # support probe must not gate on quant geometry it will never use
+    qb = (cfg.quant_block
+          if cfg.quant_encoder and cfg.activation == "topk" else 0)
+    return batch is None or fek.supported(
+        batch, cfg.n_sources * cfg.d_in, cfg.dict_size, cfg.topk_k,
+        dtype_of(cfg.enc_dtype), qb,
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _sparse_topk_from_h(
     h: jax.Array, W_dec: jax.Array, k: int
@@ -693,14 +865,28 @@ def get_losses(
                         # CSE to dedupe a second encode matmul
     aux_active = dead_mask is not None and cfg.aux_k > 0
     sparse_bwd = factored and use_sparse_bwd(cfg, x.shape[0])
+    fused = use_fused_encoder(cfg, x.shape[0])
     if factored and sparse_bwd and not aux_active:
         # sparse backward plane, full-step scope: encode + TopK + factored
         # decode under ONE custom vjp (ops/sparse_grad.py) — none of the
         # three dense backward matmuls survives. Forward numerics are the
-        # factored tier's exactly (same einsum/kernel/gather chain).
-        recon_f32, vals, idx = _sparse_topk_step(
-            x, params["W_enc"], params["b_enc"], params["W_dec"], cfg.topk_k
-        )
+        # factored tier's exactly (same einsum/kernel/gather chain). The
+        # fused tier (cfg.fused_encoder) swaps that forward for the
+        # encoder→TopK megakernel — same (vals, idx) contract, same
+        # backward, no [B, H] pre-act matrix in HBM; aux-active steps
+        # fall through to the (h, W_dec) scope below (the h-residual
+        # escape hatch — the aux ranking consumes the pre-acts).
+        if fused:
+            qb = cfg.quant_block if cfg.quant_encoder else 0
+            recon_f32, vals, idx = _fused_topk_step(
+                x, params["W_enc"], params["b_enc"], params["W_dec"],
+                cfg.topk_k, qb,
+            )
+        else:
+            recon_f32, vals, idx = _sparse_topk_step(
+                x, params["W_enc"], params["b_enc"], params["W_dec"],
+                cfg.topk_k,
+            )
         recon = (recon_f32 + params["b_dec"].astype(jnp.float32)).astype(x.dtype)
         f = None
     elif factored:
@@ -720,6 +906,17 @@ def get_losses(
         recon_f32, vals, idx = sparse_topk_forward(params, x, cfg)
         recon = recon_f32.astype(x.dtype)
         f = None
+    elif cfg.activation == "batchtopk" and fused and not aux_active:
+        # fused BatchTopK: encoder matmul + global-threshold bisection +
+        # emit over streamed VMEM tiles (the pre-acts are recomputed per
+        # bisection pass instead of round-tripping [B, H] through HBM);
+        # f is bit-identical to the dense chain, gradients are the dense
+        # straight-through VJP. AuxK steps keep the dense encode (the
+        # aux ranking needs h — same escape hatch as the topk tier).
+        f = _fused_batchtopk_encode(
+            x, params["W_enc"], params["b_enc"], cfg.topk_k
+        )
+        recon = decode(params, f)
     elif cfg.activation == "jumprelu" and cfg.l0_coeff > 0:
         h = pre_acts(params, x)
         f = act_ops.apply(h, cfg, params)

@@ -1,0 +1,696 @@
+"""Fused encoder→TopK megakernel: melt the dense floor.
+
+docs/SCALING.md's FLOP model left the encoder forward as "the irreducible
+dense floor": every latent's pre-act is needed for the TopK ranking, so
+the factored/sparse tiers still materialized the full ``[B, dict]``
+pre-activation matrix in HBM just to top-k-reduce it — at dict 2^17 that
+is ~1 GB of bf16 written by the matmul and re-read by the selection
+kernel, and BENCH_r05 shows it as the whole residual between TopK and
+ReLU step time (1.08–1.12×). The FLOPs are unavoidable; the HBM
+round-trip is not (Densifying Assumed-sparse Tensors, arXiv:1905.04035:
+layout, not FLOPs, decides this shape of op).
+
+This module fuses the two: a Pallas kernel tiles the encoder matmul
+``x·W_enc + b_enc`` over the DICTIONARY axis, keeps each ``[R, cw]``
+pre-activation tile in VMEM, and folds it into a running per-row top-k
+before the next tile overwrites it — so the only encode-side HBM traffic
+is one read of ``x``, one streamed read of ``W_enc`` (the same bytes the
+dense matmul reads), and a ``[B, k]`` (vals, idx) write. The Ragged
+Paged Attention kernel discipline (arXiv:2604.15464): reduction state
+lives in VMEM scratch across a sequential grid axis while operand tiles
+stream through double-buffered blocks.
+
+Selection runs in the order-isomorphic int32 BIT-PATTERN space of the
+ReLU'd f32 pre-acts (the ops/topk_pallas composite-key machinery), with
+the PR 1 sign-aware NaN clamp: positive-NaN patterns merge at a sentinel
+just above +inf, sign-set patterns (negative NaN, −0.0) map to the
+sentinel / zero respectively — so the integer compares form a total
+order, ties at the k-th value break by LOWEST global index exactly as
+``lax.top_k`` does, and a NaN pre-act occupies a slot but is dropped at
+emit (``value > 0`` is false for NaN), matching
+``sparsify(topk(h, k), k)``'s drain contract bit for bit on finite rows.
+
+Per streamed tile the fold costs one candidate count (~3 VPU ops/el) plus
+``n_enter`` drain sweeps, where ``n_enter`` is how many of the tile's
+entries actually belong in the running top-k — k on the first tile,
+near-zero after (the running k-th value keeps rising). Total selection
+work is ~2× the sparsify drain the factored tier already pays, against
+the matmul's 2·nd FLOPs/element it rides on.
+
+Three entry points:
+
+- :func:`fused_topk_encode` — ``(vals [B,k], idx [B,k])`` straight from
+  ``(x, W_enc, b_enc)``; the forward of the model layer's
+  ``_fused_topk_step`` custom VJP (models/crosscoder.py), which hands the
+  SAME (vals, idx) contract to ``_sparse_topk_step``'s backward. AuxK
+  steps need the pre-acts ``h`` as a differentiable residual for the aux
+  ranking, so they keep the dense encode (the ``h``-residual escape
+  hatch — see ``use_fused_encoder``).
+- :func:`fused_batchtopk_encode` — the BatchTopK variant: the PR 3
+  multi-threshold global-bisection kernel re-run as a count-then-emit
+  over the same streamed tiles (the tile matmul is RECOMPUTED per
+  bisection pass — ``_FUSED_BT_T`` is tuned high so bf16's 15-bit
+  pattern space resolves in 2 passes; FLOPs go ~3×, HBM bytes drop from
+  ~7 reads/writes of ``[B, dict]`` to the weight re-reads plus ONE
+  masked-output write). Output is the masked ``[B, dict]`` activation
+  (BatchTopK has no per-row factored form), with the dense path's
+  straight-through custom VJP.
+- the **int8 block-scaled matmul path** (``cfg.quant_encoder``): the
+  TopK kernel accepts pre-quantized operands (per-block symmetric int8
+  + f32 scales along the CONTRACTION axis, the ops/quant.py layout) and
+  accumulates blockwise int8×int8→int32 MXU dots rescaled per block —
+  ~0.5× the weight-stream bytes, behind the same quality-gate shape as
+  ``--quant-grads`` (bench ``matrix`` legs record selection agreement;
+  docs/SCALING.md "Fused encoder→TopK" has the gate procedure). The
+  BatchTopK variant stays float: its bisection already trades FLOPs for
+  bytes, and stacking quantization error into a GLOBAL order statistic
+  needs its own quality evidence first.
+
+Dispatch: hardware opt-in ``CROSSCODER_FUSED_TOPK_PALLAS=1`` (or the
+``CROSSCODER_PALLAS=all`` umbrella — ops/dispatch.py), interpret mode
+for CPU tests; unsupported shapes fall back to the dense encode + the
+existing TopK/BatchTopK kernels/oracles, which are also the parity
+oracles the tests pin this module against.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from crosscoder_tpu.ops.topk_pallas import (
+    _n_bisect_passes,
+    _shift_and_range,
+)
+
+DISPATCH_ENV = "CROSSCODER_FUSED_TOPK_PALLAS"
+
+# VMEM budget shared with the other kernel modules (topk_pallas et al.).
+_VMEM_BUDGET_BYTES = 13 << 20
+# Dictionary-axis tile widths tried largest-first; batch row-block
+# heights likewise (multiples of 32 so every dtype's min sublane tile is
+# satisfied). The W tile is double-buffered by the pipeline (it changes
+# per grid step); the x block is revisited across the chunk sweep and
+# DMA'd once per row block.
+_CHUNK_CANDIDATES = (512, 256, 128)
+_ROW_CANDIDATES = (128, 96, 64, 32)
+
+# f32 pattern-space constants for the selection keys: SENT is the
+# smallest-NaN pattern (just above +inf's 0x7F800000) that every NaN
+# clamps to — ordering AMONG NaN payloads is outside the oracle contract
+# (lax.top_k's NaN ranking is unspecified), but a NaN must outrank every
+# finite value so it visibly occupies a slot instead of silently
+# corrupting the bisection-free compare chain. NEG_INF_BITS is −inf's
+# pattern as a signed int32: sign-set patterns STRICTLY ABOVE it are
+# negative NaNs (→ SENT); everything else sign-set (−0.0, or a negative
+# a nonconforming max let through) maps to 0, exactly what max(x, 0)
+# should have produced. Same clamp as topk_pallas's composite kernel,
+# in the unshifted f32 space.
+_SENT = 0x7F800001                       # python ints: pallas kernels
+_NEG_INF_BITS = 0xFF800000 - (1 << 32)   # may not close over jnp consts
+_BIG = 2**31 - 1
+
+# Global-bisection thresholds per pass for the fused BatchTopK variant.
+# Each pass RECOMPUTES the tile matmuls (the pre-acts are never stored),
+# so passes are the expensive unit here — unlike topk_pallas's
+# _BATCHTOPK_T=15 (whose passes are cheap re-reads), T=255 buys bf16's
+# 15-bit pattern space in 2 passes and f32's 31-bit in 4, at ~2·T VPU
+# ops/element/pass against the matmul's 2·nd FLOPs/element.
+_FUSED_BT_T = 255
+
+# test-only: route the kernels through the Pallas interpreter (CPU CI).
+# Read at TRACE time — set before the first jit trace of the consumer.
+_INTERPRET = False
+
+
+def set_interpret(flag: bool) -> None:
+    global _INTERPRET
+    _INTERPRET = flag
+
+
+def kernel_enabled() -> bool:
+    """Whether the fused kernels may dispatch: the interpreter (CPU
+    tests) or a real TPU with the opt-in env set (the shared
+    ops/dispatch gate)."""
+    from crosscoder_tpu.ops.dispatch import hw_kernel_enabled
+
+    return hw_kernel_enabled(DISPATCH_ENV, _INTERPRET)
+
+
+# ---------------------------------------------------------------------------
+# geometry + support gate
+# ---------------------------------------------------------------------------
+
+
+def _geometry(nd: int, n_rows: int, itemsize: int,
+              quant_block: int = 0) -> tuple[int, int]:
+    """(row_block, chunk_width) fitting the VMEM budget, or (0, 0).
+
+    Working set per grid step: the double-buffered W tile, the resident
+    x row block, the int32 key workspace + transient f32 pre-act tile,
+    and the bias tile. The quantized variant swaps int8 operands (+ f32
+    per-block scales) for the float ones.
+    """
+    for cw in _CHUNK_CANDIDATES:
+        for rows in _ROW_CANDIDATES:
+            if quant_block:
+                nb = nd // quant_block
+                used = (
+                    2 * nd * cw * 1 + 2 * nb * cw * 4   # Wq tile + scales (dbl-buf)
+                    + rows * nd * 1 + rows * nb * 4      # xq block + scales
+                    + rows * cw * 8                      # key work + f32 tile
+                    + cw * 8
+                )
+            else:
+                used = (
+                    2 * nd * cw * itemsize               # W tile (dbl-buffered)
+                    + rows * nd * itemsize               # x block (resident)
+                    + rows * cw * 8                      # key work + f32 tile
+                    + cw * 8                             # bias tile
+                )
+            if used <= _VMEM_BUDGET_BYTES:
+                # shrink to the smallest 32-multiple covering small batches
+                r = rows
+                while r - 32 >= n_rows and r > 32:
+                    r -= 32
+                return r, cw
+    return 0, 0
+
+
+def supported(n_rows: int, nd: int, width: int, k: int, dtype,
+              quant_block: int = 0) -> bool:
+    """Shapes the fused kernels handle: kernel dtypes, a lane-aligned
+    contraction axis, a sane k (the sparsify cap), any dictionary width
+    >= k (non-tile-divisible tails are masked in-kernel), and a VMEM-
+    fitting tile geometry. ``quant_block`` > 0 additionally requires the
+    per-block scale layout (lane-aligned block dividing the contraction
+    axis)."""
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return False
+    if nd < 128 or nd % 128:
+        return False
+    if not (0 < k <= 128 and k <= width):
+        return False
+    if quant_block and (quant_block % 128 or nd % quant_block):
+        return False
+    itemsize = jnp.dtype(dtype).itemsize
+    rows, _ = _geometry(nd, n_rows, itemsize, quant_block)
+    return rows > 0
+
+
+# ---------------------------------------------------------------------------
+# tile pre-activation: shared by the TopK fold and the BatchTopK passes
+# ---------------------------------------------------------------------------
+
+
+def _tile_preacts_dense(x_ref, w_ref, b_ref, out_dtype):
+    """One ``[R, cw]`` pre-activation tile: f32 MXU accumulation + bias,
+    cast through the compute dtype exactly as ``crosscoder.pre_acts``
+    does — the cast is what makes the fused selection bit-identical to
+    the dense oracle's."""
+    acc = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+    return (acc + b_ref[:]).astype(out_dtype)
+
+
+def _tile_preacts_quant(xq_ref, xs_ref, wq_ref, ws_ref, b_ref, out_dtype,
+                        quant_block: int):
+    """The int8 block-scaled tile matmul: per contraction block,
+    int8×int8→int32 on the MXU, rescaled by the (row, block) × (block,
+    col) f32 scale product — the ops/quant.py layout with the dequantize
+    folded into the accumulation instead of materializing bf16 operands."""
+    nd = xq_ref.shape[1]
+    nb = nd // quant_block
+    rows = xq_ref.shape[0]
+    cw = wq_ref.shape[1]
+    acc = jnp.zeros((rows, cw), jnp.float32)
+    for b in range(nb):
+        lo = b * quant_block
+        hi = lo + quant_block
+        part = jax.lax.dot_general(
+            xq_ref[:, lo:hi], wq_ref[lo:hi, :],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        acc = acc + (part.astype(jnp.float32)
+                     * xs_ref[:, b:b + 1] * ws_ref[b:b + 1, :])
+    return (acc + b_ref[:]).astype(out_dtype)
+
+
+def _select_keys(h_tile: jax.Array, gcol: jax.Array,
+                 width: int) -> jax.Array:
+    """ReLU'd tile values as sign-clamped f32 bit patterns — the total
+    order the fold selects in. Padded tail columns (``gcol >= width``)
+    are forced to 0 so they can never enter the running top-k."""
+    hp = jnp.maximum(h_tile.astype(jnp.float32), 0.0)
+    bits = jax.lax.bitcast_convert_type(hp, jnp.int32)
+    neg = bits < 0
+    skey = jnp.where(
+        neg,
+        jnp.where(bits > _NEG_INF_BITS, _SENT, jnp.int32(0)),
+        jnp.minimum(bits, _SENT),
+    )
+    return jnp.where(gcol < width, skey, 0)
+
+
+# ---------------------------------------------------------------------------
+# fused TopK kernel: stream tiles, fold into a running per-row top-k
+# ---------------------------------------------------------------------------
+
+
+def _fold_and_emit(h_tile, vals_ref, idx_ref, key_s, kidx_s, work_s, *,
+                   k: int, width: int, cw: int, n_chunks: int,
+                   out_dtype) -> None:
+    """The selection body shared by the dense and int8 kernels.
+
+    Running state: ``key_s``/``kidx_s`` ``[R, k]`` — the k best
+    (pattern, global index) pairs seen so far, UNSORTED; the current
+    worst slot is recomputed per insertion (min key, then max index,
+    then lowest slot — a unique slot even among empty (0, 0) pads).
+    The drain loop's trip count adapts to how many tile entries beat
+    the pre-tile worst: an upper bound on insertions, since the worst
+    only rises, and the picks descend the total order so the first
+    ``n_enter`` picks are exactly the candidates.
+    """
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        key_s[:] = jnp.zeros_like(key_s)
+        kidx_s[:] = jnp.zeros_like(kidx_s)
+        vals_ref[:] = jnp.zeros_like(vals_ref)
+        idx_ref[:] = jnp.zeros_like(idx_ref)
+
+    rows = h_tile.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (rows, cw), 1)
+    gcol = c * cw + col
+    work_s[:] = _select_keys(h_tile, gcol, width)
+    lane_k = jax.lax.broadcasted_iota(jnp.int32, (rows, k), 1)
+
+    def _worst(bk, bi):
+        """(key, idx, slot-mask) of the current worst running slot."""
+        wkey = jnp.min(bk, axis=-1, keepdims=True)
+        widx = jnp.max(jnp.where(bk == wkey, bi, -1), axis=-1, keepdims=True)
+        cand = (bk == wkey) & (bi == widx)
+        slot = jnp.min(jnp.where(cand, lane_k, _BIG), axis=-1, keepdims=True)
+        return wkey, widx, cand & (lane_k == slot)
+
+    wkey0, widx0, _ = _worst(key_s[:], kidx_s[:])
+    wk0 = work_s[:]
+    enter = (wk0 > wkey0) | ((wk0 == wkey0) & (wk0 > 0) & (gcol < widx0))
+    # `enter` over-counts on an all-zero running state (first tile: every
+    # positive entry), but at most k insertions can ever stick — once a
+    # tile's k best are folded in, the running worst dominates the rest
+    # of the descending pick order — so k caps the sweep count too
+    n_iter = jnp.minimum(
+        jnp.max(jnp.sum(enter.astype(jnp.int32), axis=-1)), k)
+
+    def body(t, _):
+        wk = work_s[:]
+        m = jnp.max(wk, axis=-1, keepdims=True)
+        sel_m = (wk == m) & (m > 0)
+        pick = jnp.min(jnp.where(sel_m, gcol, _BIG), axis=-1, keepdims=True)
+        sel = sel_m & (gcol == pick)
+        work_s[:] = jnp.where(sel, 0, wk)
+        bk = key_s[:]
+        bi = kidx_s[:]
+        wkey, widx, wslot = _worst(bk, bi)
+        beats = (m > wkey) | ((m == wkey) & (m > 0) & (pick < widx))
+        repl = wslot & beats
+        key_s[:] = jnp.where(repl, m, bk)
+        kidx_s[:] = jnp.where(repl, pick, bi)
+        return 0
+
+    jax.lax.fori_loop(0, n_iter, body, 0)
+
+    @pl.when(c == n_chunks - 1)
+    def _emit():
+        # drain the k slots lowest-global-index-first, positives only —
+        # the sparsify(topk(h, k), k) contract: ascending index,
+        # (0.0, 0)-padded; a NaN slot (value > 0 is false) is dropped
+        # exactly as the sparsify drain drops it.
+        def drain(t, _):
+            bk = key_s[:]
+            bi = kidx_s[:]
+            bv = jax.lax.bitcast_convert_type(bk, jnp.float32)
+            rem = bv > 0
+            pick = jnp.min(jnp.where(rem, bi, _BIG), axis=-1, keepdims=True)
+            valid = pick < _BIG
+            sel = rem & (bi == pick)
+            v = jnp.sum(jnp.where(sel, bv, 0.0), axis=-1, keepdims=True)
+            write = (lane_k == t) & valid
+            vals_ref[:] = jnp.where(write, v.astype(out_dtype), vals_ref[:])
+            idx_ref[:] = jnp.where(write, pick, idx_ref[:])
+            key_s[:] = jnp.where(sel, 0, bk)
+            return 0
+
+        jax.lax.fori_loop(0, k, drain, 0)
+
+
+def _fused_topk_kernel(x_ref, w_ref, b_ref, vals_ref, idx_ref,
+                       key_s, kidx_s, work_s, *, k: int, width: int,
+                       cw: int, n_chunks: int, out_dtype) -> None:
+    h_tile = _tile_preacts_dense(x_ref, w_ref, b_ref, out_dtype)
+    _fold_and_emit(h_tile, vals_ref, idx_ref, key_s, kidx_s, work_s,
+                   k=k, width=width, cw=cw, n_chunks=n_chunks,
+                   out_dtype=out_dtype)
+
+
+def _fused_topk_kernel_q(xq_ref, xs_ref, wq_ref, ws_ref, b_ref, vals_ref,
+                         idx_ref, key_s, kidx_s, work_s, *, k: int,
+                         width: int, cw: int, n_chunks: int, out_dtype,
+                         quant_block: int) -> None:
+    h_tile = _tile_preacts_quant(xq_ref, xs_ref, wq_ref, ws_ref, b_ref,
+                                 out_dtype, quant_block)
+    _fold_and_emit(h_tile, vals_ref, idx_ref, key_s, kidx_s, work_s,
+                   k=k, width=width, cw=cw, n_chunks=n_chunks,
+                   out_dtype=out_dtype)
+
+
+def _pad_operands(x2: jax.Array, W2: jax.Array, b: jax.Array,
+                  rows: int, cw: int):
+    """Pad batch rows to the row-block multiple and the dictionary axis
+    to the tile multiple. Padded columns carry zero weights/bias and are
+    masked in-kernel (``gcol >= width``); padded rows compute garbage
+    that is sliced off (per-row selection is independent)."""
+    n_rows, nd = x2.shape
+    width = W2.shape[1]
+    rpad = (-n_rows) % rows
+    hpad = (-width) % cw
+    if rpad:
+        x2 = jnp.pad(x2, ((0, rpad), (0, 0)))
+    if hpad:
+        W2 = jnp.pad(W2, ((0, 0), (0, hpad)))
+        b = jnp.pad(b, ((0, hpad),))
+    return x2, W2, b, n_rows, width
+
+
+def _quantize_contraction(x2: jax.Array, W2: jax.Array, block: int):
+    """Block-scaled int8 operands along the CONTRACTION axis, lifted
+    from ops/quant.py: x rows quantize per (row, block); W quantizes per
+    (block, column) — i.e. per-block along each column, which is the
+    transpose layout of ``quantize_blocks``."""
+    from crosscoder_tpu.ops import quant
+
+    xq, xs = quant.quantize_blocks(x2, block)              # [B,nd], [B,nb]
+    wqT, wsT = quant.quantize_blocks(W2.T, block)          # [H,nd], [H,nb]
+    return xq, xs, wqT.T, wsT.T                            # wq [nd,H], ws [nb,H]
+
+
+def fused_topk_encode(x2: jax.Array, W2: jax.Array, b_enc: jax.Array,
+                      k: int, *, quant_block: int = 0,
+                      interpret: bool = False
+                      ) -> tuple[jax.Array, jax.Array]:
+    """Fused ``topk_pallas.sparsify(topk(x2·W2 + b, k), k)`` without the
+    ``[B, width]`` intermediate: ``(vals [B, k], idx [B, k] int32)``,
+    ascending index, (0.0, 0)-padded.
+
+    ``x2 [B, nd]`` in the compute dtype, ``W2 [nd, width]``, ``b_enc
+    [width]`` (any float dtype; applied in f32 like ``pre_acts``).
+    Unsupported shapes fall back to the dense encode + the existing
+    TopK/sparsify kernels — the exact forward ``_sparse_topk_step``
+    runs, which is also this kernel's parity oracle.
+    NON-differentiable by design: the model layer's custom VJPs own the
+    gradient (the straight-through/scatter backward never needs the
+    dense pre-acts).
+    """
+    interpret = interpret or _INTERPRET
+    n_rows, nd = x2.shape
+    width = W2.shape[1]
+    if not supported(n_rows, nd, width, k, x2.dtype, quant_block):
+        from crosscoder_tpu.ops import topk_pallas
+
+        hf = jnp.dot(x2, W2, preferred_element_type=jnp.float32)
+        h = (hf + b_enc.astype(jnp.float32)).astype(x2.dtype)
+        f = topk_pallas.topk(h, k, interpret)
+        return topk_pallas.sparsify(f, k, interpret)
+
+    itemsize = jnp.dtype(x2.dtype).itemsize
+    rows, cw = _geometry(nd, n_rows, itemsize, quant_block)
+    x2p, W2p, bp, n_real, _ = _pad_operands(
+        x2, W2, b_enc.astype(jnp.float32), rows, cw)
+    n_chunks = W2p.shape[1] // cw
+    n_rb = x2p.shape[0] // rows
+    b2 = bp.reshape(1, -1)
+
+    compiler_params = None
+    if not interpret:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        )
+    common = dict(
+        out_shape=[
+            jax.ShapeDtypeStruct((x2p.shape[0], k), x2.dtype),
+            jax.ShapeDtypeStruct((x2p.shape[0], k), jnp.int32),
+        ],
+        grid=(n_rb, n_chunks),
+        out_specs=[
+            pl.BlockSpec((rows, k), lambda i, c: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, k), lambda i, c: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, k), jnp.int32),      # running keys
+            pltpu.VMEM((rows, k), jnp.int32),      # running indices
+            pltpu.VMEM((rows, cw), jnp.int32),     # tile key workspace
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )
+    if quant_block:
+        xq, xs, wq, ws = _quantize_contraction(x2p, W2p, quant_block)
+        nb = nd // quant_block
+        vals, idx = pl.pallas_call(
+            functools.partial(
+                _fused_topk_kernel_q, k=k, width=width, cw=cw,
+                n_chunks=n_chunks, out_dtype=x2.dtype,
+                quant_block=quant_block,
+            ),
+            in_specs=[
+                pl.BlockSpec((rows, nd), lambda i, c: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((rows, nb), lambda i, c: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((nd, cw), lambda i, c: (0, c),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((nb, cw), lambda i, c: (0, c),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, cw), lambda i, c: (0, c),
+                             memory_space=pltpu.VMEM),
+            ],
+            **common,
+        )(xq, xs, wq, ws, b2)
+    else:
+        vals, idx = pl.pallas_call(
+            functools.partial(
+                _fused_topk_kernel, k=k, width=width, cw=cw,
+                n_chunks=n_chunks, out_dtype=x2.dtype,
+            ),
+            in_specs=[
+                pl.BlockSpec((rows, nd), lambda i, c: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((nd, cw), lambda i, c: (0, c),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, cw), lambda i, c: (0, c),
+                             memory_space=pltpu.VMEM),
+            ],
+            **common,
+        )(x2p, W2p, b2)
+    return vals[:n_real], idx[:n_real]
+
+
+# ---------------------------------------------------------------------------
+# fused BatchTopK: global bisection + emit over the same streamed tiles
+# ---------------------------------------------------------------------------
+
+
+def _mids_scalar(lo, hi, j: int, t: int):
+    """j-th of t candidate thresholds strictly inside (lo, hi) — the
+    topk_pallas._mid_scalar spacing, parameterized by t."""
+    r1 = hi - lo - 1
+    q = r1 // t
+    rem = r1 - q * t
+    return lo + 1 + q * j + (rem * j) // t
+
+
+def _tile_bits(h_tile, gcol, row_gidx, width: int, n_real: int,
+               shift: int):
+    """Shifted ReLU'd bit patterns of one tile, with padded tail columns
+    AND padded batch rows forced to 0 — a nonzero bias would otherwise
+    resurrect zero-padded rows into the GLOBAL order statistic."""
+    hp = jnp.maximum(h_tile.astype(jnp.float32), 0.0)
+    bits = jax.lax.bitcast_convert_type(hp, jnp.int32)
+    if shift:
+        bits = jax.lax.shift_right_logical(bits, shift)
+    bits = jnp.maximum(bits, 0)          # sign-set strays never count
+    return jnp.where((gcol < width) & (row_gidx < n_real), bits, 0)
+
+
+def _fused_bt_bisect_kernel(x_ref, w_ref, b_ref, kth_ref, lo_s, hi_s,
+                            cnt_s, *, kk: int, width: int, cw: int,
+                            rows: int, n_real: int, shift: int,
+                            hi_init: int, n_passes: int, n_rb: int,
+                            n_chunks: int, out_dtype) -> None:
+    """Grid ``(n_passes, row_blocks, chunks)``, all sequential: the PR 3
+    global multi-threshold bisection with the tile RECOMPUTED from the
+    fused matmul each visit (pre-acts are never stored). SMEM carries
+    (lo, hi) and the T counts across the whole batch sweep."""
+    p = pl.program_id(0)
+    r = pl.program_id(1)
+    c = pl.program_id(2)
+
+    @pl.when((p == 0) & (r == 0) & (c == 0))
+    def _init():
+        lo_s[0] = 0
+        hi_s[0] = hi_init
+
+    @pl.when((r == 0) & (c == 0))
+    def _reset_counts():
+        for j in range(_FUSED_BT_T):
+            cnt_s[j] = 0
+
+    h_tile = _tile_preacts_dense(x_ref, w_ref, b_ref, out_dtype)
+    col = jax.lax.broadcasted_iota(jnp.int32, (rows, cw), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (rows, cw), 0)
+    bits = _tile_bits(h_tile, c * cw + col, r * rows + row, width,
+                      n_real, shift)
+    lo = lo_s[0]
+    hi = hi_s[0]
+    for j in range(_FUSED_BT_T):
+        mid_j = _mids_scalar(lo, hi, j, _FUSED_BT_T)
+        cnt_s[j] = cnt_s[j] + jnp.sum((bits >= mid_j).astype(jnp.int32))
+
+    @pl.when((r == n_rb - 1) & (c == n_chunks - 1))
+    def _finish_pass():
+        num_ge = jnp.int32(0)
+        for j in range(_FUSED_BT_T):
+            num_ge = num_ge + (cnt_s[j] >= kk).astype(jnp.int32)
+        new_lo = lo
+        new_hi = hi
+        for j in range(_FUSED_BT_T):
+            mid_j = _mids_scalar(lo, hi, j, _FUSED_BT_T)
+            new_lo = jnp.where(num_ge == j + 1, mid_j, new_lo)
+            new_hi = jnp.where(num_ge == j, mid_j, new_hi)
+        lo_s[0] = new_lo
+        hi_s[0] = new_hi
+
+        @pl.when(p == n_passes - 1)
+        def _emit_result():
+            kth_ref[0, 0] = new_lo
+
+
+def _fused_bt_emit_kernel(x_ref, w_ref, b_ref, kth_ref, out_ref, *,
+                          width: int, cw: int, rows: int, n_real: int,
+                          shift: int, out_dtype) -> None:
+    """Grid ``(row_blocks, chunks)``: recompute each tile once more and
+    apply the converged global threshold — the ONLY ``[B, width]``-sized
+    HBM write of the fused BatchTopK (the dense path writes the pre-acts
+    AND re-reads them per bisection pass)."""
+    r = pl.program_id(0)
+    c = pl.program_id(1)
+    h_tile = _tile_preacts_dense(x_ref, w_ref, b_ref, out_dtype)
+    hp = jnp.maximum(h_tile.astype(jnp.float32), 0.0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (rows, cw), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (rows, cw), 0)
+    bits = _tile_bits(h_tile, c * cw + col, r * rows + row, width,
+                      n_real, shift)
+    kth = kth_ref[0, 0]
+    keep = (bits >= kth) & (bits > 0)
+    out_ref[:] = jnp.where(keep, hp, 0.0).astype(out_ref.dtype)
+
+
+def fused_batchtopk_encode_raw(x2: jax.Array, W2: jax.Array,
+                               b_enc: jax.Array, k: int, *,
+                               interpret: bool = False) -> jax.Array:
+    """Fused ``activations.batchtopk(x2·W2 + b, k)``: the masked
+    ``[B, width]`` activations (ALL threshold ties kept), bit-identical
+    to the dense oracle, without materializing the pre-acts for the
+    bisection. Non-differentiable; the model layer's custom VJP owns the
+    straight-through gradient. Falls back to the dense encode + the
+    activations-layer BatchTopK on unsupported shapes."""
+    interpret = interpret or _INTERPRET
+    n_rows, nd = x2.shape
+    width = W2.shape[1]
+    if not supported(n_rows, nd, width, k, x2.dtype):
+        from crosscoder_tpu.ops import activations as act_ops
+
+        hf = jnp.dot(x2, W2, preferred_element_type=jnp.float32)
+        h = (hf + b_enc.astype(jnp.float32)).astype(x2.dtype)
+        return act_ops.batchtopk(h, k)
+
+    itemsize = jnp.dtype(x2.dtype).itemsize
+    rows, cw = _geometry(nd, n_rows, itemsize)
+    x2p, W2p, bp, n_real, _ = _pad_operands(
+        x2, W2, b_enc.astype(jnp.float32), rows, cw)
+    n_chunks = W2p.shape[1] // cw
+    n_rb = x2p.shape[0] // rows
+    b2 = bp.reshape(1, -1)
+    shift, hi_init = _shift_and_range(x2.dtype)
+    n_passes = _n_bisect_passes(hi_init, _FUSED_BT_T)
+    kk = min(k * n_rows, n_rows * width)
+
+    bisect_params = None
+    emit_params = None
+    if not interpret:
+        bisect_params = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")
+        )
+        emit_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        )
+    kth = pl.pallas_call(
+        functools.partial(
+            _fused_bt_bisect_kernel, kk=kk, width=width, cw=cw, rows=rows,
+            n_real=n_real, shift=shift, hi_init=hi_init,
+            n_passes=n_passes, n_rb=n_rb, n_chunks=n_chunks,
+            out_dtype=x2.dtype,
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        grid=(n_passes, n_rb, n_chunks),
+        in_specs=[
+            pl.BlockSpec((rows, nd), lambda p, i, c: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nd, cw), lambda p, i, c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cw), lambda p, i, c: (0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda p, i, c: (0, 0),
+                               memory_space=pltpu.SMEM),
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((_FUSED_BT_T,), jnp.int32),
+        ],
+        compiler_params=bisect_params,
+        interpret=interpret,
+    )(x2p, W2p, b2)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _fused_bt_emit_kernel, width=width, cw=cw, rows=rows,
+            n_real=n_real, shift=shift, out_dtype=x2.dtype,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (x2p.shape[0], W2p.shape[1]), x2.dtype),
+        grid=(n_rb, n_chunks),
+        in_specs=[
+            pl.BlockSpec((rows, nd), lambda i, c: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nd, cw), lambda i, c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, cw), lambda i, c: (0, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, c: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, cw), lambda i, c: (i, c),
+                               memory_space=pltpu.VMEM),
+        compiler_params=emit_params,
+        interpret=interpret,
+    )(x2p, W2p, b2, kth)
+    return out[:n_real, :width]

@@ -485,7 +485,16 @@ class Trainer:
         calls exactly what it always called)."""
         if self._obs is None:
             return fn
-        label = ("train_step(metrics={}, aux={}, refresh={})".format(*key))
+        from crosscoder_tpu.utils.compile_cache import variant_key
+
+        # the encoder tier traced into this variant (trace-time static):
+        # aux-on steps keep the dense encode (the h-residual escape
+        # hatch), so the enc tag follows the aux key
+        enc = "dense"
+        if not (key[1] and self.cfg.aux_k > 0) and cc.use_fused_encoder(
+                self.cfg, self.cfg.batch_size):
+            enc = "fused-int8" if self.cfg.quant_encoder else "fused"
+        label = variant_key(*key, enc=enc)
         return self._obs.observe_step(label, fn)
 
     def _device_scale(self) -> jax.Array:

@@ -23,6 +23,23 @@ import time
 from typing import Any
 
 
+def variant_key(metrics: bool, aux: bool, refresh: bool, *,
+                enc: str = "dense") -> str:
+    """Canonical compile-event key for one train-step variant.
+
+    ``(metrics, aux, refresh)`` is the Trainer's compiled-variant cache
+    tuple; ``enc`` names the encoder tier actually traced into the
+    variant ("dense", "fused", "fused-int8" — cfg.fused_encoder /
+    cfg.quant_encoder resolved at build time), so compile telemetry and
+    the HLO cost-analysis report distinguish a fused step from a dense
+    one instead of aliasing them under one label. Every writer of a
+    step-variant key goes through here — the single place the key
+    format lives.
+    """
+    return (f"train_step(metrics={metrics}, aux={aux}, "
+            f"refresh={refresh}, enc={enc})")
+
+
 def enable(cache_dir: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at ``cache_dir``.
 

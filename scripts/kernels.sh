@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Standalone interpret-mode kernel parity suite: every Pallas kernel's
 # CPU oracle tests (topk / sparsify / quant / sparse_grad / batchtopk /
-# paged_attention),
+# paged_attention / fused encoder→topk),
 # without the full tier-1 run — so a kernel regression is catchable in
 # ~a minute while iterating on ops/. Same pytest flags as tier1.sh so
 # the two gates can never diverge on collection behavior.
@@ -17,4 +17,6 @@ exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
   tests/test_sparse_grad.py \
   tests/test_batchtopk_pallas.py \
   tests/test_paged_attention.py \
+  tests/test_fused_encoder_topk.py \
+  tests/test_dispatch.py \
   "$@"

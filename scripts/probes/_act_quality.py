@@ -28,6 +28,7 @@ Writes artifacts/ACT_QUALITY_r04.json. Run on TPU:
 """
 
 from __future__ import annotations
+import _bootstrap  # noqa: F401  (repo-root sys.path + cwd shim)
 
 import json
 import os

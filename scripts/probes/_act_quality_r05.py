@@ -30,6 +30,7 @@ Writes artifacts/ACT_QUALITY_r05.json.
 """
 
 from __future__ import annotations
+import _bootstrap  # noqa: F401  (repo-root sys.path + cwd shim)
 
 import json
 import os

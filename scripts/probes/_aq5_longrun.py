@@ -7,6 +7,7 @@ findings (reuses the r05 rig's harness/arms verbatim, longer horizon):
 
 Writes artifacts/ACT_QUALITY_r05_50k.json.
 """
+import _bootstrap  # noqa: F401  (repo-root sys.path + cwd shim)
 import os
 os.environ.setdefault("AQ5_OUT", "artifacts/ACT_QUALITY_r05_50k.json")
 import json

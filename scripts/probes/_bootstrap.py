@@ -1,0 +1,21 @@
+"""Path shim for the one-off probe scripts (scripts/probes/).
+
+These probes historically lived at the repo root, where ``import
+crosscoder_tpu`` and the cwd-relative ``artifacts/`` writes worked by
+accident of invocation. Now that they live under scripts/probes/, each
+probe imports this module first: it puts the repo root on ``sys.path``
+(the package is not pip-installed in the probe environments) and chdirs
+there, so ``python scripts/probes/_topk_probe.py`` keeps working from
+anywhere and keeps writing ``artifacts/`` at the repo root.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+_ROOT = Path(__file__).resolve().parents[2]
+if str(_ROOT) not in sys.path:
+    sys.path.insert(0, str(_ROOT))
+os.chdir(_ROOT)

@@ -13,6 +13,7 @@ The "blocking" number is what training stalls per periodic save; sync-vs-
 blocking is the overlap win; the SIGTERM preemption window shrinks from
 (fetch+write) to (fetch) + joined-write-at-exit.
 """
+import _bootstrap  # noqa: F401  (repo-root sys.path + cwd shim)
 
 import argparse
 import json

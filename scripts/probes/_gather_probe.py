@@ -13,6 +13,7 @@ nd=4608, bf16):
 Each timed op's full output feeds a reduction consumed by the carry.
 """
 from __future__ import annotations
+import _bootstrap  # noqa: F401  (repo-root sys.path + cwd shim)
 
 import json
 import time

@@ -27,6 +27,7 @@ Writes artifacts/REFILL_QUALITY_r03.json. Run on TPU (~10 min):
 """
 
 from __future__ import annotations
+import _bootstrap  # noqa: F401  (repo-root sys.path + cwd shim)
 
 import json
 import time

@@ -1,3 +1,4 @@
+import _bootstrap  # noqa: F401  (repo-root sys.path + cwd shim)
 import os, time
 import jax, jax.numpy as jnp
 import numpy as np

@@ -20,6 +20,7 @@ adds. This probe times each candidate building block on the real chip:
 Writes artifacts/TOPK_PROBE_r05.json.
 """
 from __future__ import annotations
+import _bootstrap  # noqa: F401  (repo-root sys.path + cwd shim)
 
 import json
 import time

@@ -18,6 +18,7 @@ Writes artifacts/TRAJ_PARITY_r05.json.
 """
 
 from __future__ import annotations
+import _bootstrap  # noqa: F401  (repo-root sys.path + cwd shim)
 
 import json
 import os
