@@ -1,0 +1,37 @@
+"""Static correctness plane: contract engine + rule packs.
+
+Three rule families, each a pure function of a prebuilt context:
+
+- ``hlo_rules``     — AOT-lowered step HLO / jaxpr contracts (StepContext)
+- ``pallas_safety`` — Pallas kernel BlockSpec/VMEM/race analysis (PallasContext)
+- ``ast_lints``     — repo-wide source invariants (SourceContext)
+
+``scripts/analyze.py`` is the CLI; ``mutations`` carries one seeded
+violation per rule so the checker itself is checked.
+"""
+
+from crosscoder_tpu.analysis.contracts.ast_lints import (AST_RULES,
+                                                         SourceContext,
+                                                         build_source_context)
+from crosscoder_tpu.analysis.contracts.engine import (Finding, Report, Rule,
+                                                      run_rules)
+from crosscoder_tpu.analysis.contracts.hlo_rules import (HLO_RULES,
+                                                         StepContext,
+                                                         build_step_context,
+                                                         check_compiled_text,
+                                                         lower_step_text)
+from crosscoder_tpu.analysis.contracts.mutations import (ALL_RULES, MUTATIONS,
+                                                         run_mutation)
+from crosscoder_tpu.analysis.contracts.pallas_safety import (PALLAS_RULES,
+                                                             PallasContext,
+                                                             run_kernel_probes,
+                                                             vmem_summary)
+
+__all__ = [
+    "Finding", "Report", "Rule", "run_rules",
+    "HLO_RULES", "StepContext", "build_step_context", "lower_step_text",
+    "check_compiled_text",
+    "PALLAS_RULES", "PallasContext", "run_kernel_probes", "vmem_summary",
+    "AST_RULES", "SourceContext", "build_source_context",
+    "ALL_RULES", "MUTATIONS", "run_mutation",
+]

@@ -1,0 +1,406 @@
+"""HLO/jaxpr contract rules over AOT-lowered train-step variants.
+
+The framework's central scaling claim is that every knob is zero-cost
+off and every fusion's byte win is structural, not incidental. Those are
+*compiler-level* facts: they live in the lowered step program, the same
+artifact ``utils/compile_cache.observed`` AOT-compiles and reports at
+runtime. This module lowers a lattice of step variants once (tiny
+shapes, CPU) and runs declarative checks over the StableHLO text and
+the traced jaxpr:
+
+- **knob-off identity** — a knob that is present-but-off lowers the
+  byte-identical program (generalizes the scattered asserts of
+  ``tests/test_quant.py`` / ``test_obs.py`` / ``test_fused_encoder_topk.py``
+  into one parametrized sweep, which those tests now wrap);
+- **no-s8-when-quant-off** / **no-f64-anywhere** — dtype hygiene;
+- **donation honored** — every donated train-state leaf carries an
+  input/output alias (``tf.aliasing_output``) in the lowered signature;
+- **fused-no-dense-preacts** — with the fused encoder live, no
+  ``[B, dict]``-shaped tensor exists anywhere in the program (the PR 6
+  bytes-deleted claim, verified statically per variant);
+- **no-host-transfers** — no infeed/outfeed/send/recv/host-callback
+  inside the step;
+- **no large captured constants** — closed-over concrete arrays above a
+  size threshold in the step jaxpr (the classic silent-bloat bug where
+  a traced-in array is baked into every compiled variant).
+
+Rules here are pure functions of :class:`StepContext` data so the
+mutation self-tests (``mutations.py``) can prove each rule fires on a
+seeded violation without recompiling anything.
+
+Probe geometry note: the fused ``[B, dict]`` scan needs every
+distinguished dimension distinct (``B != n·d != dict != k``), otherwise
+legitimate tiles alias the forbidden shape — e.g. the fused kernel's
+``[R, cw]`` VMEM workspace at ``R=32, cw=512`` is indistinguishable from
+a ``[B=32, dict=512]`` pre-act matrix.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import re
+from dataclasses import dataclass, field
+from typing import Any
+
+from crosscoder_tpu.analysis.contracts.engine import Finding, Rule
+
+# a captured constant this large in the step jaxpr is a bug: step inputs
+# arrive as arguments (donated or streamed), never baked into the program
+LARGE_CONST_BYTES = 1 << 18
+
+# callback/transfer markers that must never appear inside the step: the
+# train step is a pure device program (the obs plane's zero-transfer
+# guarantee, tests/test_obs.py::test_obs_adds_no_host_device_transfers,
+# made static)
+HOST_TRANSFER_TOKENS = (
+    "stablehlo.infeed", "stablehlo.outfeed", "stablehlo.send",
+    "stablehlo.recv", "cpu_callback", "python_callback", "io_callback",
+)
+
+_I8_RE = re.compile(r"(?:<|x)i8>")
+_F64_RE = re.compile(r"(?:<|x)f64>")
+
+
+@dataclass
+class VariantMeta:
+    """What the checks need to know about one lowered variant."""
+
+    n_donated_leaves: int = 0
+    quant_off: bool = True                  # no int8 may appear
+    forbid_dense_shape: tuple[int, int] | None = None   # (B, dict) if fused
+
+
+@dataclass
+class StepContext:
+    """Lowered step variants + jaxpr const inventory for the HLO rules."""
+
+    texts: dict[str, str] = field(default_factory=dict)
+    meta: dict[str, VariantMeta] = field(default_factory=dict)
+    # label -> [(nbytes, description)] of closed-over jaxpr constants
+    jaxpr_consts: dict[str, list[tuple[int, str]]] = field(default_factory=dict)
+    # (label_a, label_b, what-knob) pairs that must be byte-identical
+    identity_pairs: list[tuple[str, str, str]] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# variant construction (the only part that touches jax)
+
+
+def lower_step_text(cfg, n_devices: int = 1) -> str:
+    """Lower one train-step variant and return its StableHLO text.
+
+    This is THE shared harness the step-HLO-identity tests deduplicate
+    onto (previously copy-pasted as ``_lower_step_text`` in three test
+    modules): eval-shape state init, mesh shardings, AOT lower of
+    ``make_train_step`` — no device execution, CPU-safe.
+    """
+    text, _ = lower_step(cfg, n_devices)
+    return text
+
+
+def lower_step(cfg, n_devices: int = 1) -> tuple[str, int]:
+    """``(stablehlo_text, n_donated_state_leaves)`` for one variant."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train.trainer import make_train_step
+
+    mesh = mesh_lib.make_mesh(devices=jax.devices()[:n_devices])
+    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    state = jax.eval_shape(lambda k: init_train_state(k, cfg, tx),
+                           jax.random.key(0))
+    shardings = mesh_lib.state_shardings(mesh, state, cfg.shard_sources)
+    step = make_train_step(cfg, mesh, tx, shardings)
+    state_sh = jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        state, shardings,
+    )
+    batch = jax.ShapeDtypeStruct(
+        (cfg.batch_size, cfg.n_sources, cfg.d_in), jnp.float32,
+        sharding=mesh_lib.batch_sharding(mesh),
+    )
+    scale = jax.ShapeDtypeStruct((cfg.n_sources,), jnp.float32,
+                                 sharding=NamedSharding(mesh, P()))
+    text = step.lower(state_sh, batch, scale).as_text()
+    return text, len(jax.tree_util.tree_leaves(state_sh))
+
+
+def step_jaxpr_consts(cfg) -> list[tuple[int, str]]:
+    """``(nbytes, description)`` for every concrete array closed over by
+    the traced step jaxpr. A clean step captures nothing: all tensors
+    arrive as arguments."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+    from crosscoder_tpu.train.trainer import make_train_step
+
+    mesh = mesh_lib.make_mesh(devices=jax.devices()[:1])
+    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    state = jax.eval_shape(lambda k: init_train_state(k, cfg, tx),
+                           jax.random.key(0))
+    shardings = mesh_lib.state_shardings(mesh, state, cfg.shard_sources)
+    step = make_train_step(cfg, mesh, tx, shardings)
+    state_sh = jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        state, shardings,
+    )
+    batch = jax.ShapeDtypeStruct(
+        (cfg.batch_size, cfg.n_sources, cfg.d_in), jnp.float32,
+        sharding=mesh_lib.batch_sharding(mesh),
+    )
+    scale = jax.ShapeDtypeStruct((cfg.n_sources,), jnp.float32,
+                                 sharding=NamedSharding(mesh, P()))
+    traced = step.trace(state_sh, batch, scale)
+    out = []
+    for c in traced.jaxpr.consts:
+        nbytes = getattr(c, "nbytes", 0) or 0
+        out.append((int(nbytes),
+                    f"{getattr(c, 'dtype', type(c).__name__)}"
+                    f"{list(getattr(c, 'shape', []))}"))
+    return out
+
+
+@contextlib.contextmanager
+def _interpret_kernels(flag: bool):
+    """Flip every step-path kernel module's interpret latch, restoring on
+    exit — the CPU stand-in that makes 'kernel live' variants lowerable."""
+    from crosscoder_tpu.ops import (fused_encoder_topk, sparse_grad,
+                                    topk_pallas)
+
+    mods = (fused_encoder_topk, sparse_grad, topk_pallas)
+    prev = [m._INTERPRET for m in mods]
+    for m in mods:
+        m.set_interpret(flag)
+    try:
+        yield
+    finally:
+        for m, p in zip(mods, prev):
+            m.set_interpret(p)
+
+
+def _cfg(**kw):
+    from crosscoder_tpu.config import CrossCoderConfig
+
+    base = dict(d_in=8, dict_size=32, batch_size=32, enc_dtype="fp32")
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+# knob lattice: each entry is (label, overrides) that must lower the
+# byte-identical program to the bare baseline — the zero-cost-off
+# contract for every host-side / data-plane knob, singly and combined
+KNOB_OFF_LATTICE: tuple[tuple[str, dict[str, Any]], ...] = (
+    ("quant", dict(quant_buffer=True, quant_block=8)),
+    ("obs", dict(obs="on", obs_dir="/tmp/obs", profile_steps="3:5",
+                 log_print_every=7)),
+    ("paged_harvest", dict(harvest_runtime="paged", page_size=16,
+                           seq_len=1024)),
+    ("resilience", dict(guard_loss=True, harvest_timeout_s=2.0,
+                        keep_saves=2)),
+    ("logging", dict(log_backend="jsonl", profile_dir="/tmp/prof")),
+    ("all_knobs", dict(quant_buffer=True, quant_block=8, obs="on",
+                       harvest_runtime="paged", page_size=16, seq_len=1024,
+                       guard_loss=True, log_backend="jsonl")),
+)
+
+# the sparse/fused tiers: "off" vs a dead "auto" (no kernel live) must be
+# byte-identical — the knob's PRESENCE costs nothing
+_SPARSE_SHAPE = dict(d_in=128, dict_size=256, batch_size=32, topk_k=8,
+                     l1_coeff=0.0)
+# all distinguished dims distinct (see module docstring): B=192, n·d=256,
+# dict=1024, k=8
+_FUSED_SHAPE = dict(d_in=128, dict_size=1024, batch_size=192, topk_k=8,
+                    l1_coeff=0.0)
+
+
+def build_step_context(full: bool = True) -> StepContext:
+    """Lower the variant lattice. ``full=False`` skips the interpret-mode
+    fused-live variant (the slowest lowering) for quick iterations."""
+    ctx = StepContext()
+
+    def add(label, cfg, **meta_kw):
+        text, n_leaves = lower_step(cfg)
+        ctx.texts[label] = text
+        ctx.meta[label] = VariantMeta(n_donated_leaves=n_leaves, **meta_kw)
+        ctx.jaxpr_consts[label] = []
+        return label
+
+    with _interpret_kernels(False):
+        add("base", _cfg())
+        ctx.jaxpr_consts["base"] = step_jaxpr_consts(_cfg())
+        for label, overrides in KNOB_OFF_LATTICE:
+            add(f"off:{label}", _cfg(**overrides))
+            ctx.identity_pairs.append(("base", f"off:{label}", label))
+        for act in ("topk", "batchtopk"):
+            a = add(f"{act}:fused_off",
+                    _cfg(activation=act, fused_encoder="off", **_SPARSE_SHAPE))
+            b = add(f"{act}:fused_auto_dead",
+                    _cfg(activation=act, fused_encoder="auto", **_SPARSE_SHAPE))
+            ctx.identity_pairs.append((a, b, f"fused_encoder[{act}]"))
+        a = add("topk:sparse_off",
+                _cfg(activation="topk", sparse_bwd="off", **_SPARSE_SHAPE))
+        b = add("topk:sparse_auto_dead",
+                _cfg(activation="topk", sparse_bwd="auto", **_SPARSE_SHAPE))
+        ctx.identity_pairs.append((a, b, "sparse_bwd"))
+
+    if full:
+        with _interpret_kernels(True):
+            cfg = _cfg(activation="topk", fused_encoder="on", sparse_bwd="on",
+                       **_FUSED_SHAPE)
+            add("topk:fused_live", cfg,
+                forbid_dense_shape=(cfg.batch_size, cfg.dict_size))
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# rules (pure functions of StepContext)
+
+
+def _is_step_ctx(ctx: Any) -> bool:
+    return isinstance(ctx, StepContext) and bool(ctx.texts)
+
+
+def _check_identity(ctx: StepContext) -> list[Finding]:
+    out = []
+    for a, b, knob in ctx.identity_pairs:
+        if ctx.texts[a] != ctx.texts[b]:
+            out.append(Finding(
+                rule="hlo-knob-off-identity", location=f"{a} vs {b}",
+                message=f"knob '{knob}' present-but-off changes the "
+                        f"compiled step ({len(ctx.texts[a])} vs "
+                        f"{len(ctx.texts[b])} chars) — the zero-cost-off "
+                        f"contract is broken",
+            ))
+    return out
+
+
+def _check_no_s8(ctx: StepContext) -> list[Finding]:
+    out = []
+    for label, text in ctx.texts.items():
+        if ctx.meta[label].quant_off and _I8_RE.search(text):
+            out.append(Finding(
+                rule="hlo-no-s8-when-quant-off", location=label,
+                message="int8 tensor in a quant-off step variant",
+            ))
+    return out
+
+
+def _check_no_f64(ctx: StepContext) -> list[Finding]:
+    out = []
+    for label, text in ctx.texts.items():
+        if _F64_RE.search(text):
+            out.append(Finding(
+                rule="hlo-no-f64", location=label,
+                message="f64 tensor in the step (a silent 2x bytes/flops "
+                        "upcast — x64 must stay disabled end to end)",
+            ))
+    return out
+
+
+def _check_donation(ctx: StepContext) -> list[Finding]:
+    out = []
+    for label, text in ctx.texts.items():
+        want = ctx.meta[label].n_donated_leaves
+        got = text.count("tf.aliasing_output")
+        if got < want:
+            out.append(Finding(
+                rule="hlo-donation-honored", location=label,
+                message=f"only {got}/{want} donated train-state leaves "
+                        f"carry an input/output alias — a dropped "
+                        f"donation silently doubles that leaf's HBM",
+            ))
+    return out
+
+
+def _check_fused_no_dense(ctx: StepContext) -> list[Finding]:
+    out = []
+    for label, text in ctx.texts.items():
+        shape = ctx.meta[label].forbid_dense_shape
+        if shape is None:
+            continue
+        b, h = shape
+        pat = re.compile(rf"tensor<(?:\d+x)*{b}x{h}x(?:f32|bf16|f16)>")
+        hits = pat.findall(text)
+        if hits:
+            out.append(Finding(
+                rule="hlo-fused-no-dense-preacts", location=label,
+                message=f"{len(hits)} [B={b}, dict={h}] tensors in a "
+                        f"fused-encoder-live step — the pre-act matrix "
+                        f"the fusion exists to never materialize",
+            ))
+    return out
+
+
+def _check_host_transfers(ctx: StepContext) -> list[Finding]:
+    out = []
+    for label, text in ctx.texts.items():
+        for tok in HOST_TRANSFER_TOKENS:
+            if tok in text:
+                out.append(Finding(
+                    rule="hlo-no-host-transfers", location=label,
+                    message=f"host-transfer marker '{tok}' inside the "
+                            f"compiled step (steps must be pure device "
+                            f"programs; telemetry is host-side only)",
+                ))
+    return out
+
+
+def _check_large_consts(ctx: StepContext) -> list[Finding]:
+    out = []
+    for label, consts in ctx.jaxpr_consts.items():
+        for nbytes, descr in consts:
+            if nbytes > LARGE_CONST_BYTES:
+                out.append(Finding(
+                    rule="jaxpr-no-large-captured-consts", location=label,
+                    message=f"step jaxpr closes over a {nbytes}-byte "
+                            f"constant {descr} (> {LARGE_CONST_BYTES}) — "
+                            f"baked into every compiled variant instead "
+                            f"of passed as an argument",
+                ))
+    return out
+
+
+HLO_RULES: list[Rule] = [
+    Rule("hlo-knob-off-identity",
+         "present-but-off knobs lower the byte-identical step program",
+         _is_step_ctx, _check_identity),
+    Rule("hlo-no-s8-when-quant-off",
+         "no int8 tensor appears in any quant-off step variant",
+         _is_step_ctx, _check_no_s8),
+    Rule("hlo-no-f64",
+         "no f64 tensor appears in any step variant",
+         _is_step_ctx, _check_no_f64),
+    Rule("hlo-donation-honored",
+         "every donated train-state leaf has an input/output alias",
+         _is_step_ctx, _check_donation),
+    Rule("hlo-fused-no-dense-preacts",
+         "fused-encoder-live variants contain no [B, dict] tensor",
+         _is_step_ctx, _check_fused_no_dense),
+    Rule("hlo-no-host-transfers",
+         "no infeed/outfeed/send/recv/callback inside the step",
+         _is_step_ctx, _check_host_transfers),
+    Rule("jaxpr-no-large-captured-consts",
+         "the step jaxpr closes over no large concrete arrays",
+         _is_step_ctx, _check_large_consts),
+]
+
+
+def check_compiled_text(key: str, text: str) -> list[Finding]:
+    """The runtime hook surface for ``utils/compile_cache.observed``:
+    the subset of HLO rules that apply to a single already-lowered
+    program (no baseline to compare against, donation count unknown).
+    Never raises."""
+    ctx = StepContext(texts={key: text}, meta={key: VariantMeta()},
+                      jaxpr_consts={key: []})
+    findings = []
+    findings.extend(_check_no_f64(ctx))
+    findings.extend(_check_host_transfers(ctx))
+    return findings

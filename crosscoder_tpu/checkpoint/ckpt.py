@@ -28,6 +28,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import sys
 import threading
 from pathlib import Path
 from typing import Any
@@ -303,7 +304,7 @@ class Checkpointer:
                     self._prune_saves(save_dir, cfg.keep_saves)
                     if self.chaos is not None:
                         self.chaos.corrupt_save(save_dir, v)
-                    print(f"Saved as version {v} in {save_dir}")
+                    print(f"Saved as version {v} in {save_dir}", file=sys.stderr)
 
             if background:
                 def guarded() -> None:
@@ -452,7 +453,7 @@ class Checkpointer:
                 self._bump("corrupt_artifact_skips")
                 print(f"[crosscoder_tpu] checkpoint save {v} in {vdir} "
                       f"failed checksum verification; falling back to the "
-                      f"previous intact save", flush=True)
+                      f"previous intact save", flush=True, file=sys.stderr)
         raise FileNotFoundError(
             f"no complete save under {dirs} passed checksum verification"
         )
@@ -561,7 +562,7 @@ class Checkpointer:
                 agreed = _agree_min(v)
                 if agreed != v:
                     print(f"[crosscoder_tpu] multihost restore agreement: "
-                          f"local save {v} -> agreed save {agreed}", flush=True)
+                          f"local save {v} -> agreed save {agreed}", flush=True, file=sys.stderr)
                     v = agreed
                     if not self.verify_save(vdir, v):
                         raise ValueError(

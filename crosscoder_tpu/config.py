@@ -822,6 +822,17 @@ class CrossCoderConfig:
         return base.replace(**overrides) if overrides else base
 
 
+def known_attrs() -> frozenset[str]:
+    """Every public name resolvable on a ``CrossCoderConfig`` instance:
+    dataclass fields, properties, and methods. The static cfg-field lint
+    (analysis/contracts/ast_lints.py) checks every ``cfg.<attr>`` read in
+    the codebase against this surface, so a typo'd knob read fails lint
+    instead of raising AttributeError three hours into a run."""
+    names = {f.name for f in dataclasses.fields(CrossCoderConfig)}
+    names.update(n for n in vars(CrossCoderConfig) if not n.startswith("_"))
+    return frozenset(names)
+
+
 def _parse_bool(s: str) -> bool:
     low = s.lower()
     if low in ("1", "true", "yes", "on"):

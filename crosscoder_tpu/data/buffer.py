@@ -59,6 +59,16 @@ from crosscoder_tpu.utils import pipeline
 
 _BF16 = np.dtype(jnp.bfloat16.dtype)
 
+# Harvest dispatch/drain and the serve gather run under
+# pipeline.sharded_program_guard(): when two buffers live in one process
+# (paired-trainer tests, A/B sweeps) with prefetching trainers, producer
+# threads and the main thread would otherwise execute sharded programs
+# concurrently on the same device set, which can deadlock XLA:CPU (see
+# the guard's docstring). The guard is process-wide and a no-op off-CPU;
+# producer threads only exist in single-process mode (trainer disables
+# prefetch on multi-process meshes), so it cannot cross-host desync, and
+# buffers never wait on each other, so lock ordering is trivial.
+
 
 class _SingleDispatchJob:
     """Adapter giving an already-dispatched harvest future the
@@ -72,6 +82,9 @@ class _SingleDispatchJob:
 
     def step(self) -> bool:
         return False
+
+    def inflight(self):
+        return [self._result]
 
     def result(self):
         return self._result
@@ -505,7 +518,11 @@ class PairedActivationBuffer:
                 return False
             self._cyc_job = self._create_job()
         job, n, seq_globals, woff = self._cyc_job
-        if not job.step():
+        alive = job.step()
+        # the dispatched quantum must finish inside the program guard on
+        # XLA:CPU (dispatch is async; see pipeline.sharded_program_guard)
+        pipeline.finish_on_cpu(job.inflight())
+        if not alive:
             self._cyc_inflight.append((job.result(), n, seq_globals, woff))
             self._cyc_job = None
         return True
@@ -544,15 +561,16 @@ class PairedActivationBuffer:
         SPMD rendezvous-order requirement that ruled out the old
         is_ready() opportunistic drain.
         """
-        credit = self._cyc_segs_per_serve
-        while credit > 0 and self._step_job():
-            credit -= 1
-        while self._head_drainable():
-            # span site (docs/OBSERVABILITY.md): one harvest chunk landing
-            # (device fetch + store scatter) — a no-op unless a tracer is
-            # installed (cfg.obs="on")
-            with trace.span("harvest"):
-                self._drain_one()
+        with pipeline.sharded_program_guard():
+            credit = self._cyc_segs_per_serve
+            while credit > 0 and self._step_job():
+                credit -= 1
+            while self._head_drainable():
+                # span site (docs/OBSERVABILITY.md): one harvest chunk
+                # landing (device fetch + store scatter) — a no-op unless
+                # a tracer is installed (cfg.obs="on")
+                with trace.span("harvest"):
+                    self._drain_one()
 
     def _finish_cycle(self) -> None:
         """Complete the cycle: dispatch the remainder (none in steady
@@ -562,7 +580,8 @@ class PairedActivationBuffer:
         The ``refill`` span here brackets the serve-trigger completion —
         the residual refill bubble the incremental dispatches exist to
         amortize, now directly visible per cycle in the trace."""
-        with trace.span("refill", target_rows=self._cyc_target):
+        with trace.span("refill", target_rows=self._cyc_target), \
+                pipeline.sharded_program_guard():
             while (self._cyc_seq_done < self._cyc_batches
                    or self._cyc_job is not None):
                 if not self._step_job():    # depth window full: free a slot
@@ -829,6 +848,14 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
                 self._pad_limit() + np.arange(pad_rows, dtype=positions.dtype),
             ])
         self._scatter_chunk(positions, acts_dev)
+        # the scatter program (mesh variant: all_gather + sharded write)
+        # must finish inside the program guard on XLA:CPU
+        pipeline.finish_on_cpu([
+            a for a in (getattr(self, "_store_dev", None),
+                        getattr(self, "_store_q", None),
+                        getattr(self, "_store_scale", None))
+            if a is not None
+        ])
         self._src_global[positions[: n * rows_per_seq]] = np.repeat(
             seq_globals, rows_per_seq
         )
@@ -836,17 +863,23 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
 
     def next(self) -> jax.Array:
         """fp32 normalized batch, DEVICE-resident."""
-        out = self._gather_rows(self._next_idx())
-        out = out.astype(jnp.float32) * jnp.asarray(
-            self.normalisation_factor
-        )[None, :, None]
+        # the serve gather is a sharded program too (mesh variant:
+        # psum_scatter) — same XLA:CPU concurrency guard as the refill
+        with pipeline.sharded_program_guard():
+            out = self._gather_rows(self._next_idx())
+            out = out.astype(jnp.float32) * jnp.asarray(
+                self.normalisation_factor
+            )[None, :, None]
+            pipeline.finish_on_cpu(out)
         self._after_serve()
         return out
 
     def next_raw(self) -> jax.Array:
         """Raw bf16 batch, DEVICE-resident (the trainer's fast path — the
         step applies the norm factors on device)."""
-        out = self._gather_rows(self._next_idx())
+        with pipeline.sharded_program_guard():
+            out = self._gather_rows(self._next_idx())
+            pipeline.finish_on_cpu(out)
         self._after_serve()
         return out
 

@@ -20,6 +20,8 @@ Cache formats, in preference order:
 
 from __future__ import annotations
 
+import sys
+
 from pathlib import Path
 
 import numpy as np
@@ -137,7 +139,7 @@ def _emit_length_stats(tokens: np.ndarray) -> np.ndarray:
         f"mean {s['mean_len']}/{s['seq_len']}, padding efficiency "
         f"{s['padding_efficiency']:.2%} → paged matmul speedup ~"
         f"{s['paged_matmul_speedup_estimate']}x"
-    )
+    , file=sys.stderr)
     return tokens
 
 
@@ -164,7 +166,7 @@ def load_pile_lmsys_mixed_tokens(
             cfg.seq_len,
         ))
 
-    print(f"[crosscoder_tpu] downloading {cfg.dataset_name} (first run only)")
+    print(f"[crosscoder_tpu] downloading {cfg.dataset_name} (first run only)", file=sys.stderr)
     import datasets  # deferred: network path
 
     ds = datasets.load_dataset(cfg.dataset_name, split="train")
@@ -172,5 +174,5 @@ def load_pile_lmsys_mixed_tokens(
     tokens = np.ascontiguousarray(ds["input_ids"].astype(np.int32, copy=False))
     data_dir.mkdir(parents=True, exist_ok=True)
     np.save(npy, tokens)
-    print(f"[crosscoder_tpu] cached {tokens.shape} tokens at {npy}")
+    print(f"[crosscoder_tpu] cached {tokens.shape} tokens at {npy}", file=sys.stderr)
     return _emit_length_stats(rechunk(tokens, cfg.seq_len))

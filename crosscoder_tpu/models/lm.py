@@ -746,6 +746,13 @@ class SegmentedHarvest:
         n_scan = min(cfg.n_layers, _scan_stop(_hook_layers(cfg, tuple(hook_points))))
         return n_models * max(1, -(-n_scan // cls.seg_layers()))
 
+    def inflight(self):
+        """Arrays dispatched but possibly still executing — for callers
+        that must drive the pipeline to quiescence before releasing a
+        dispatch guard (utils/pipeline.sharded_program_guard)."""
+        return [x for x in (self._resid, self._buf, self._out)
+                if x is not None]
+
     def step(self) -> bool:
         """Dispatch the next quantum; False once fully dispatched."""
         if self._out is not None:

@@ -100,17 +100,23 @@ def log_gate_state(force: bool = False) -> None:
     global _LOGGED
     if _LOGGED and not force:
         return
-    _LOGGED = True
-    for w in validate_env():
-        print(w, file=sys.stderr, flush=True)
+    # validate BEFORE latching: a malformed umbrella raises out of
+    # _umbrella_value(), and latching first would mark the table as
+    # already-logged so the retry after the caller handles the error
+    # (or a test's second dispatch) silently skips validation forever
+    warnings = validate_env()
+    umbrella = _umbrella_value()
     states = ", ".join(
         f"{g.removeprefix('CROSSCODER_').removesuffix('_PALLAS').lower()}="
         f"{'on' if resolve_gate(g) else 'off'}"
         for g in KNOWN_GATES
     )
+    _LOGGED = True
+    for w in warnings:
+        print(w, file=sys.stderr, flush=True)
     print(
-        f"[crosscoder_tpu] pallas gates ({UMBRELLA_ENV}="
-        f"{_umbrella_value()}): {states}",
+        f"[crosscoder_tpu] pallas gates ({UMBRELLA_ENV}={umbrella}): "
+        f"{states}",
         file=sys.stderr, flush=True,
     )
 

@@ -34,7 +34,6 @@ import re
 from dataclasses import dataclass, field
 
 import jax
-import numpy as np
 
 # ---------------------------------------------------------------------------
 # HLO parsing

@@ -40,6 +40,7 @@ Grammar (``N`` = event index, ``SEC`` = float seconds):
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from pathlib import Path
@@ -219,4 +220,4 @@ class Chaos:
             flipped[off] ^= 0xFF
             path.write_bytes(bytes(flipped))
         print(f"[crosscoder_tpu] chaos: corrupted ({self.corrupt_mode}) "
-              f"{path.name} of save {v}", flush=True)
+              f"{path.name} of save {v}", flush=True, file=sys.stderr)

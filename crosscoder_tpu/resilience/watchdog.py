@@ -30,6 +30,7 @@ watchdog on multi-process meshes for the same reason it disables prefetch.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Any, Callable
@@ -108,7 +109,7 @@ class Watchdog:
                               waited_s=patience)
                 print(f"[crosscoder_tpu] watchdog: {self.name} stall "
                       f"#{extensions} (waited {patience:.1f}s); "
-                      f"extending wait", flush=True)
+                      f"extending wait", flush=True, file=sys.stderr)
                 patience *= 2
             err = outcome.get("error")
             if err is None:
@@ -122,7 +123,7 @@ class Watchdog:
                           attempt=attempt, error=type(err).__name__)
             print(f"[crosscoder_tpu] watchdog: {self.name} failed "
                   f"({type(err).__name__}: {err}); retry {attempt}/"
-                  f"{self.retries} in {delay:.2f}s", flush=True)
+                  f"{self.retries} in {delay:.2f}s", flush=True, file=sys.stderr)
             time.sleep(delay)
 
     def close(self) -> None:

@@ -16,6 +16,7 @@ from the latest checkpoint (full TrainState + data stream).
 
 from __future__ import annotations
 
+import sys
 from typing import Any, Sequence
 
 from crosscoder_tpu.checkpoint.ckpt import Checkpointer
@@ -80,7 +81,7 @@ def main(argv: list[str] | None = None) -> Trainer:
     cfg = CrossCoderConfig.from_cli(argv)
     mesh = mesh_lib.mesh_from_cfg(cfg)
     if distributed:
-        print(f"[crosscoder_tpu] multihost: {multihost.process_info()}")
+        print(f"[crosscoder_tpu] multihost: {multihost.process_info()}", file=sys.stderr)
     # fault injection (cfg.chaos / CROSSCODER_CHAOS env): None unless a
     # chaos spec was explicitly configured — production runs construct no
     # chaos objects and every hook site stays a no-op is-None check
@@ -92,7 +93,7 @@ def main(argv: list[str] | None = None) -> Trainer:
 
         print(f"[crosscoder_tpu] CHAOS ENABLED: "
               f"{(cfg.chaos or os.environ.get('CROSSCODER_CHAOS', ''))!r}",
-              flush=True)
+              flush=True, file=sys.stderr)
     buffer, cfg = build_buffer(cfg, mesh, chaos=chaos)
     trainer = Trainer(
         cfg, buffer, mesh=mesh,
@@ -105,7 +106,7 @@ def main(argv: list[str] | None = None) -> Trainer:
     )
     if cfg.resume:
         meta = trainer.restore()
-        print(f"[crosscoder_tpu] resumed at step {meta['step']}")
+        print(f"[crosscoder_tpu] resumed at step {meta['step']}", file=sys.stderr)
     trainer.train()
     return trainer
 

@@ -29,6 +29,7 @@ import concurrent.futures
 import contextlib
 import functools
 import math
+import sys
 import threading
 import time
 import types
@@ -45,6 +46,7 @@ from crosscoder_tpu.models import crosscoder as cc
 from crosscoder_tpu.parallel import mesh as mesh_lib
 from crosscoder_tpu.train import schedules
 from crosscoder_tpu.train.state import TrainState, init_train_state, make_optimizer
+from crosscoder_tpu.utils import pipeline
 from crosscoder_tpu.utils.logging import MetricsLogger, ResilienceCounters, source_tag
 
 
@@ -352,7 +354,7 @@ class Trainer:
                 # that disables prefetch below
                 print("[crosscoder_tpu] harvest watchdog disabled on a "
                       "multi-process mesh (retries would desync cross-host "
-                      "dispatch order)", flush=True)
+                      "dispatch order)", flush=True, file=sys.stderr)
             else:
                 from crosscoder_tpu.resilience.watchdog import Watchdog
 
@@ -400,7 +402,7 @@ class Trainer:
                     else "XLA scatter fallback (forced; expect the dense "
                          "backward to be faster)")
             print(f"[crosscoder_tpu] sparse backward plane active: {kind}",
-                  flush=True)
+                  flush=True, file=sys.stderr)
         # compiled step variants, keyed (with_metrics, aux_on, mask_refresh);
         # built lazily except the default. aux_on alternates per
         # cfg.aux_every (AuxK amortization), mask_refresh per
@@ -440,7 +442,7 @@ class Trainer:
             # mismatch. Serve synchronously instead.
             print("[crosscoder_tpu] prefetch disabled on a multi-process "
                   "mesh (nondeterministic cross-host dispatch order)",
-                  flush=True)
+                  flush=True, file=sys.stderr)
         elif cfg.prefetch:
             self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="batch-prefetch"
@@ -471,7 +473,7 @@ class Trainer:
         elif hasattr(self.buffer, "ensure_filled"):
             # checkpoint carries no buffer state (foreign/weights-only save):
             # fall back to a fresh calibrate+fill now, not a crash mid-loop
-            print("[crosscoder_tpu] checkpoint has no buffer state; refilling fresh")
+            print("[crosscoder_tpu] checkpoint has no buffer state; refilling fresh", file=sys.stderr)
             self.buffer.ensure_filled()
         return meta
 
@@ -664,17 +666,24 @@ class Trainer:
             rkey = jax.random.fold_in(
                 jax.random.key(cfg.seed + 0x5EED), self._host_step
             )
-            with self._dispatch_lock:
+            with self._dispatch_lock, pipeline.sharded_program_guard():
                 self.state, n_resampled = self._resample_fn(
                     self.state, batch, scale, rkey
                 )
+                pipeline.finish_on_cpu((self.state, n_resampled))
+        # the step program runs under the process-wide guard: on XLA:CPU
+        # its collectives must not execute concurrently with another
+        # sharded program (a second trainer's step, a producer thread's
+        # harvest) — see pipeline.sharded_program_guard
         if self._obs is not None:
-            with self._dispatch_lock, self._obs.tracer.span(
-                    "step", step=self._host_step):
+            with self._dispatch_lock, pipeline.sharded_program_guard(), \
+                    self._obs.tracer.span("step", step=self._host_step):
                 self.state, metrics = fn(self.state, batch, scale)
+                pipeline.finish_on_cpu((self.state, metrics))
         else:
-            with self._dispatch_lock:
+            with self._dispatch_lock, pipeline.sharded_program_guard():
                 self.state, metrics = fn(self.state, batch, scale)
+                pipeline.finish_on_cpu((self.state, metrics))
         if n_resampled is not None:
             metrics["resampled"] = n_resampled
         self._host_step += 1
@@ -750,7 +759,7 @@ class Trainer:
             )
         self.resilience.bump("rollbacks")
         print(f"[crosscoder_tpu] divergence at step {detect_step}: rolling "
-              f"back ({self._rollbacks}/{cfg.max_rollbacks})", flush=True)
+              f"back ({self._rollbacks}/{cfg.max_rollbacks})", flush=True, file=sys.stderr)
         meta = self.restore()   # newest checksum-verified save
         cand_v = meta["save_version"]
         while not self._params_finite():
@@ -792,7 +801,7 @@ class Trainer:
         self._loss_ref = None   # re-establish the spike reference fresh
         print(f"[crosscoder_tpu] rolled back to step {self.step_counter} "
               f"(save {cand_v}), skipped {n_skip} poisoned batches",
-              flush=True)
+              flush=True, file=sys.stderr)
 
     def _final_save_agreed(self, clean: bool) -> bool:
         """All-processes-clean agreement for the final collective save,
@@ -824,7 +833,7 @@ class Trainer:
                   f"failed ({type(e).__name__}: {e}); this jax version moved "
                   f"the private jax._src.distributed path — skipping the "
                   f"final collective save (periodic saves already landed)",
-                  flush=True)
+                  flush=True, file=sys.stderr)
             return False
         if client is None:
             # no coordination client on a multi-process mesh (should not
@@ -833,7 +842,7 @@ class Trainer:
             # deadlock this function exists to prevent; skip the save
             print("[crosscoder_tpu] no coordination-service client: "
                   "skipping the final collective save (periodic saves "
-                  "already landed)", flush=True)
+                  "already landed)", flush=True, file=sys.stderr)
             return False
         try:
             # same id on every process at a clean exit (same step);
@@ -845,7 +854,7 @@ class Trainer:
             return True
         except Exception as e:  # timeout or a peer died mid-barrier
             print(f"[crosscoder_tpu] final-save barrier not reached by all "
-                  f"processes ({e}); skipping the collective save", flush=True)
+                  f"processes ({e}); skipping the collective save", flush=True, file=sys.stderr)
             return False
 
     def save(self, background: bool = False) -> None:
@@ -935,7 +944,7 @@ class Trainer:
                 return
             stop_requested = True
             print("[crosscoder_tpu] SIGTERM: stopping after this step, "
-                  "writing checkpoint", flush=True)
+                  "writing checkpoint", flush=True, file=sys.stderr)
 
         multi_process = jax.process_count() > 1
         poll_every = int(self.cfg.stop_poll_every)  # validated >= 1 in config
@@ -1069,7 +1078,7 @@ class Trainer:
             else:
                 print("[crosscoder_tpu] not all processes exited cleanly: "
                       "skipping the final (collective) checkpoint to avoid "
-                      "a cross-host deadlock", flush=True)
+                      "a cross-host deadlock", flush=True, file=sys.stderr)
             self.close()
             if self.logger is not None:
                 self.logger.close()

@@ -66,6 +66,33 @@ def enable(cache_dir: str | None = None) -> str | None:
     return cache_dir
 
 
+def contracts_check(key: str, lowered: Any) -> None:
+    """``CROSSCODER_CONTRACTS`` runtime hook: re-run the textual HLO
+    contracts (no-f64, no-host-transfer; ``hlo_rules.check_compiled_text``)
+    against the program actually being compiled, not just the variants the
+    offline sweep lowers. Off (unset/empty): a single env read, nothing
+    imported. ``1``: findings print to stderr. ``strict``: findings raise.
+    """
+    mode = os.environ.get("CROSSCODER_CONTRACTS", "")
+    if mode not in ("1", "strict"):
+        return
+    try:
+        from crosscoder_tpu.analysis.contracts.hlo_rules import \
+            check_compiled_text
+        findings = check_compiled_text(key, lowered.as_text())
+    except Exception as e:  # noqa: BLE001 — the hook must not break compiles
+        print(f"[crosscoder_tpu] contracts: runtime check of {key} "
+              f"unavailable ({type(e).__name__}: {e})",
+              file=sys.stderr, flush=True)
+        return
+    for f in findings:
+        print(f"[crosscoder_tpu] contracts: {f}", file=sys.stderr, flush=True)
+    if findings and mode == "strict":
+        raise RuntimeError(
+            f"CROSSCODER_CONTRACTS=strict: {len(findings)} contract "
+            f"violation(s) in compiled program {key!r} (see stderr)")
+
+
 class _ObservedJit:
     """A jitted callable whose FIRST call is an explicit lower+compile
     (timed, spanned, reported); later calls hit the compiled executable
@@ -90,7 +117,8 @@ class _ObservedJit:
         t0 = time.perf_counter()
         try:
             with obs.tracer.span("compile", variant=key):
-                compiled = self._jit_fn.lower(*args).compile()
+                lowered = self._jit_fn.lower(*args)
+                compiled = lowered.compile()
         except Exception as e:
             print(f"[crosscoder_tpu] obs: AOT compile of {key} failed "
                   f"({type(e).__name__}: {e}); falling back to implicit "
@@ -98,6 +126,9 @@ class _ObservedJit:
                   file=sys.stderr, flush=True)
             self._compiled = self._jit_fn
             return self._compiled(*args)
+        # outside the try: in strict mode a contract violation must fail
+        # the step, not degrade to implicit compilation
+        contracts_check(key, lowered)
         obs.on_compile(key, compiled, time.perf_counter() - t0)
         self._compiled = compiled
         return compiled(*args)

@@ -91,7 +91,7 @@ class MetricsLogger:
             except Exception as e:  # offline pod, no creds, not installed
                 if cfg.log_backend == "wandb":
                     raise
-                print(f"[crosscoder_tpu] wandb unavailable ({e}); falling back to jsonl")
+                print(f"[crosscoder_tpu] wandb unavailable ({e}); falling back to jsonl", file=sys.stderr)
                 backend = "jsonl"
         elif backend == "auto":
             backend = "jsonl"

@@ -9,9 +9,46 @@ calibration, dashboard harvest) is the same bounded FIFO window.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Callable, Iterable, TypeVar
 
 T = TypeVar("T")
+
+# see sharded_program_guard() — reentrant so a guarded serve may trigger
+# a guarded refill on the same thread
+_XLA_CPU_PROGRAM_LOCK = threading.RLock()
+
+
+def sharded_program_guard():
+    """Serialize collective-bearing program execution on XLA:CPU.
+
+    Two programs with collectives executing concurrently on the same set
+    of host devices can deadlock the CPU runtime: each program's
+    per-device executions block in a collective rendezvous while
+    occupying scheduler threads, starving the other program's remaining
+    participants (``collective_ops_utils.h`` "waiting for all participants
+    to arrive"). Hardware backends pipeline concurrent programs, so this
+    returns a null context off-CPU. Dispatch is asynchronous — releasing
+    the lock when the python call returns would not close the race — so
+    on CPU a caller must also run :func:`finish_on_cpu` on the program's
+    outputs before leaving the block."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return _XLA_CPU_PROGRAM_LOCK
+    return contextlib.nullcontext()
+
+
+def finish_on_cpu(tree) -> None:
+    """Block until ``tree``'s arrays have finished computing, on the CPU
+    backend only — the execute-to-completion half of
+    :func:`sharded_program_guard` (a no-op elsewhere: hardware backends
+    keep the async pipeline)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        jax.block_until_ready(tree)
 
 # chunks kept in flight: device compute overlaps the host fetch/scatter of
 # earlier chunks (1 = fully serial)

@@ -1,145 +1,29 @@
 #!/usr/bin/env python
-"""Metric-key namespace lint (invoked from scripts/tier1.sh).
+"""Metric-key namespace lint — shim over the contract engine.
 
-The comparison surface against the reference repo is its exact 9-key
-scalar set (utils/logging.py module docstring); everything this framework
-adds on top rides a documented namespace so the reference surface can
-never silently drift:
+The lint proper was folded into the static correctness plane as the
+``lint-metric-keys`` rule (``crosscoder_tpu/analysis/contracts/
+ast_lints.py``), where it also gained registry-binding tracking
+(``m = MetricsRegistry(); m.gauge(...)``) that the original
+receiver-name heuristic missed. This entry point is kept because
+builders and older tier-1 invocations call it directly; it preserves
+the historical CLI contract exactly — ``check_metric_keys: OK (N
+constant metric keys checked)`` on stdout, violations on stderr,
+exit 1 on any violation.
 
-- ``resilience/*`` — recovery counters (docs/resilience.md)
-- ``perf/*`` — span timings, step wall/bubble, compile events, HBM gauges
-- ``comm/*``  — predicted wire bytes + measured transfer counts
-- ``harvest/*`` — data-plane telemetry (padding efficiency)
-
-plus the documented un-namespaced extensions (docs/OBSERVABILITY.md
-"Metric key reference"): ``dead_frac``, ``aux_loss``, ``resampled``,
-``step_time_ms`` — scalars that predate the namespaces and are consumed
-by quality tooling under those exact names.
-
-The lint AST-walks every module in ``crosscoder_tpu/`` and collects
-string-constant metric keys from the two sink shapes that feed the
-MetricsLogger stream:
-
-1. registry calls — ``<registry>.count/gauge/ema/observe("key", ...)``
-   (ResilienceCounters.bump is exempt: its short keys are auto-prefixed
-   ``resilience/`` at snapshot, so they cannot escape the namespace);
-2. metric-dict stores — ``metrics[...] = / scalars[...] =`` subscript
-   assignments and ``metrics = {...}`` dict literals.
-
-f-string keys are out of scope (unlintable statically); the two dynamic
-producers — the tracer's ``perf/{name}_*`` and the registry histogram's
-``{key}_n``/``_p50``/… suffixes — are namespace-preserving by
-construction. Exit 1 with file:line diagnostics on any violation.
+Full catalog and suppression syntax: docs/ANALYSIS.md. Prefer
+``python scripts/analyze.py`` for the whole rule set.
 """
 
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-PACKAGE = Path(__file__).resolve().parent.parent / "crosscoder_tpu"
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-NAMESPACES = ("resilience/", "perf/", "comm/", "harvest/")
-
-# the reference's 9-key comparison surface (explained_variance_<tag>
-# generalized beyond the A/B pair — source_tag letters or indices)
-REFERENCE_KEYS = {
-    "loss", "l2_loss", "l1_loss", "l0_loss", "l1_coeff", "lr",
-    "explained_variance",
-}
-_EV_TAG = re.compile(r"^explained_variance_[A-H0-9]\d*$")
-
-# documented un-namespaced extensions (docs/OBSERVABILITY.md) — consumed
-# by quality tooling (_act_quality*.py, tests) under these exact names
-EXTENSION_KEYS = {
-    "dead_frac", "aux_loss", "resampled", "step_time_ms",
-    # internal pre-expansion key, flattened by expand_metrics before logging
-    "explained_variance_per_source",
-}
-
-REGISTRY_METHODS = {"count", "gauge", "ema", "observe"}
-METRIC_DICT_NAMES = {"metrics", "scalars"}
-
-
-def key_allowed(key: str) -> bool:
-    if any(key.startswith(ns) and len(key) > len(ns) for ns in NAMESPACES):
-        return True
-    return key in REFERENCE_KEYS or key in EXTENSION_KEYS \
-        or bool(_EV_TAG.match(key))
-
-
-def _receiver_tail(node: ast.expr) -> str | None:
-    """Last identifier of the call receiver (``self._obs.registry`` →
-    ``registry``) — filters registry calls from unrelated ``.count``/
-    ``.observe`` methods (e.g. SegmentedHarvest.count)."""
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    if isinstance(node, ast.Name):
-        return node.id
-    return None
-
-
-def collect_keys(tree: ast.AST) -> list[tuple[int, str]]:
-    """(lineno, key) for every string-constant metric key in the module."""
-    found: list[tuple[int, str]] = []
-    for node in ast.walk(tree):
-        # <registry>.method("key", ...)
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in REGISTRY_METHODS
-                and node.args
-                and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)
-                and _receiver_tail(node.func.value) in
-                ("registry", "reg", "r")):
-            found.append((node.lineno, node.args[0].value))
-        # metrics["key"] = ... / scalars["key"] = ...
-        elif isinstance(node, ast.Assign):
-            for tgt in node.targets:
-                if (isinstance(tgt, ast.Subscript)
-                        and isinstance(tgt.value, ast.Name)
-                        and tgt.value.id in METRIC_DICT_NAMES
-                        and isinstance(tgt.slice, ast.Constant)
-                        and isinstance(tgt.slice.value, str)):
-                    found.append((tgt.lineno, tgt.slice.value))
-            # metrics = {"key": ..., ...}
-            if (len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and node.targets[0].id in METRIC_DICT_NAMES
-                    and isinstance(node.value, ast.Dict)):
-                for k in node.value.keys:
-                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
-                        found.append((k.lineno, k.value))
-    return found
-
-
-def main() -> int:
-    violations: list[str] = []
-    n_keys = 0
-    for path in sorted(PACKAGE.rglob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for lineno, key in collect_keys(tree):
-            n_keys += 1
-            if not key_allowed(key):
-                violations.append(
-                    f"{path.relative_to(PACKAGE.parent)}:{lineno}: metric "
-                    f"key {key!r} outside the documented namespace "
-                    f"(reference 9-key | {' | '.join(NAMESPACES)} | "
-                    f"documented extensions)"
-                )
-    if violations:
-        print("check_metric_keys: FAILED", file=sys.stderr)
-        for v in violations:
-            print("  " + v, file=sys.stderr)
-        print("  (add a namespaced key, or document a new extension in "
-              "docs/OBSERVABILITY.md AND this lint's allowlist)",
-              file=sys.stderr)
-        return 1
-    print(f"check_metric_keys: OK ({n_keys} constant metric keys checked)")
-    return 0
-
+from crosscoder_tpu.analysis.contracts.ast_lints import (  # noqa: E402,F401
+    collect_keys, key_allowed, main)
 
 if __name__ == "__main__":
     sys.exit(main())

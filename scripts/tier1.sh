@@ -3,7 +3,15 @@
 # exact same command (and the same DOTS_PASSED accounting) as the driver.
 # Run from anywhere; executes at the repo root.
 cd "$(dirname "$0")/.." || exit 1
-# metric-key namespace lint (docs/OBSERVABILITY.md): the reference 9-key
-# comparison surface must never silently grow un-namespaced keys
-python scripts/check_metric_keys.py || exit 1
+# static correctness plane (docs/ANALYSIS.md): HLO knob-lattice contracts,
+# Pallas kernel safety, repo-wide AST lints (subsumes the old
+# check_metric_keys.py, kept as a shim). Nonzero on any error finding.
+env JAX_PLATFORMS=cpu python scripts/analyze.py || exit 1
+# ruff (pyflakes+isort, [tool.ruff] in pyproject.toml) when available —
+# the container may not ship it; lint-unused-imports covers F401 in-tree
+if command -v ruff >/dev/null 2>&1; then
+    ruff check crosscoder_tpu scripts || exit 1
+elif python -c 'import ruff' >/dev/null 2>&1; then
+    python -m ruff check crosscoder_tpu scripts || exit 1
+fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -58,6 +58,31 @@ def test_unknown_gate_names_get_difflib_suggestions(monkeypatch):
     assert "no-op" in warnings[0]
 
 
+def test_typo_warning_prints_at_first_dispatch(monkeypatch, capsys):
+    """The startup log validates the env BEFORE latching the one-time
+    flag: a typo'd gate name is visible on stderr at the first dispatch
+    decision, with its difflib suggestion."""
+    monkeypatch.setenv("CROSSCODER_BATCHTOK_PALLAS", "1")        # typo
+    dispatch.hw_kernel_enabled("CROSSCODER_QUANT_PALLAS", True)
+    err = capsys.readouterr().err
+    assert "unknown kernel gate CROSSCODER_BATCHTOK_PALLAS" in err
+    assert "did you mean CROSSCODER_BATCHTOPK_PALLAS?" in err
+    assert "pallas gates" in err
+
+
+def test_malformed_umbrella_does_not_latch_the_log(monkeypatch, capsys):
+    """A raising umbrella must leave the one-time latch unset, so the
+    retry after the operator fixes the env still logs the gate table
+    (and re-runs validation) instead of silently skipping both."""
+    monkeypatch.setenv(dispatch.UMBRELLA_ENV, "laa")
+    with pytest.raises(ValueError, match="must be all|off"):
+        dispatch.hw_kernel_enabled("CROSSCODER_QUANT_PALLAS", True)
+    capsys.readouterr()
+    monkeypatch.setenv(dispatch.UMBRELLA_ENV, "all")
+    dispatch.hw_kernel_enabled("CROSSCODER_QUANT_PALLAS", True)
+    assert "pallas gates (CROSSCODER_PALLAS=all)" in capsys.readouterr().err
+
+
 def test_interpret_mode_always_allowed(monkeypatch):
     # no env at all: the interpreter (CPU tests) still runs
     assert dispatch.hw_kernel_enabled("CROSSCODER_QUANT_PALLAS", True)

@@ -25,17 +25,14 @@ import time
 from pathlib import Path
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.obs import trace
 from crosscoder_tpu.obs.profiler import ProfilerWindow, parse_profile_steps
 from crosscoder_tpu.obs.registry import MetricsRegistry
 from crosscoder_tpu.obs.trace import NullTracer, SpanTracer
-from crosscoder_tpu.parallel import mesh as mesh_lib
 from crosscoder_tpu.train.trainer import Trainer
 from crosscoder_tpu.utils.logging import MetricsLogger
 
@@ -288,28 +285,11 @@ def test_obs_spans_cover_save_and_restore(tmp_path):
 # zero-cost off
 
 
-def _lower_step_text(cfg):
-    from crosscoder_tpu.train import schedules
-    from crosscoder_tpu.train.state import init_train_state, make_optimizer
-    from crosscoder_tpu.train.trainer import make_train_step
-
-    mesh = mesh_lib.make_mesh(devices=jax.devices()[:1])
-    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
-    state = jax.eval_shape(lambda k: init_train_state(k, cfg, tx),
-                           jax.random.key(0))
-    shardings = mesh_lib.state_shardings(mesh, state, cfg.shard_sources)
-    step = make_train_step(cfg, mesh, tx, shardings)
-    state_sh = jax.tree_util.tree_map(
-        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-        state, shardings,
-    )
-    batch = jax.ShapeDtypeStruct(
-        (cfg.batch_size, cfg.n_sources, cfg.d_in), jnp.float32,
-        sharding=mesh_lib.batch_sharding(mesh),
-    )
-    scale = jax.ShapeDtypeStruct((cfg.n_sources,), jnp.float32,
-                                 sharding=NamedSharding(mesh, P()))
-    return step.lower(state_sh, batch, scale).as_text()
+# the contract engine's public step-lowering harness (the same one
+# scripts/analyze.py sweeps the knob lattice with) — the local copy this
+# file used to carry is retired
+from crosscoder_tpu.analysis.contracts.hlo_rules import \
+    lower_step_text as _lower_step_text  # noqa: E402
 
 
 def test_step_hlo_independent_of_obs_config():

@@ -10,7 +10,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.data import buffer as buffer_mod
@@ -387,36 +387,16 @@ def test_step_hlo_independent_of_quant_config():
     """The compiled train step must not change when quant knobs are
     present-but-off (quant_buffer is a data-plane flag; quant_block is
     inert without a consumer): byte-identical HLO, and no int8 anywhere
-    in the off-path program."""
-    import jax.numpy as jnp
-
-    from crosscoder_tpu.train import schedules
-    from crosscoder_tpu.train.state import init_train_state, make_optimizer
-    from crosscoder_tpu.train.trainer import make_train_step
+    in the off-path program. Lowering rides the contract engine's public
+    harness (the same one scripts/analyze.py sweeps the knob lattice
+    with) — one definition of "the step program" repo-wide."""
+    from crosscoder_tpu.analysis.contracts.hlo_rules import lower_step_text
 
     texts = []
     for extra in ({}, dict(quant_buffer=True, quant_block=8)):
         cfg = CrossCoderConfig(d_in=8, dict_size=32, batch_size=32,
                                enc_dtype="fp32", **extra)
-        mesh = mesh_lib.make_mesh(devices=jax.devices()[:1])
-        tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
-        state = jax.eval_shape(lambda k: init_train_state(k, cfg, tx),
-                               jax.random.key(0))
-        shardings = mesh_lib.state_shardings(mesh, state, cfg.shard_sources)
-        step = make_train_step(cfg, mesh, tx, shardings)
-        state_sh = jax.tree_util.tree_map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            state, shardings,
-        )
-        batch = jax.ShapeDtypeStruct(
-            (cfg.batch_size, cfg.n_sources, cfg.d_in), jnp.float32,
-            sharding=mesh_lib.batch_sharding(mesh),
-        )
-        scale = jax.ShapeDtypeStruct(
-            (cfg.n_sources,), jnp.float32,
-            sharding=NamedSharding(mesh, P()),
-        )
-        texts.append(step.lower(state_sh, batch, scale).as_text())
+        texts.append(lower_step_text(cfg))
     assert texts[0] == texts[1]
     assert "s8[" not in texts[0]
 

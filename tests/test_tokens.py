@@ -99,5 +99,5 @@ def test_loader_emits_length_stats(tmp_path, capsys):
                            seq_len=16)
     np.save(tmp_path / "demo2.npy", corpus)
     tok_mod.load_pile_lmsys_mixed_tokens(cfg)
-    out = capsys.readouterr().out
+    out = capsys.readouterr().err      # diagnostics ride stderr (bench contract)
     assert "padding efficiency" in out and "100.00%" in out
