@@ -722,6 +722,119 @@ def section_e2e() -> dict:
     return out
 
 
+def section_refill_overlap() -> dict:
+    """Zero-bubble refill engine A/B (docs/SCALING.md "Zero-bubble
+    refill"): the ``e2e`` harvest→buffer→train leg run with
+    ``refill_overlap`` off vs on, at fine (SEG_LAYERS=3) and coarse
+    (SEG_LAYERS=14) harvest segmentation. Per leg: the measured refill
+    bubble fraction (obs ``take_blocked_s() / wall`` — exactly what
+    ``perf/refill_bubble_frac`` logs), the max/median step ratio (the
+    refresh spike), and acts/s/chip. Gate (ISSUE 14 acceptance): with
+    overlap ON, bubble_frac <= 0.10 AND acts/s no worse than overlap-off
+    at both segmentations."""
+    import tempfile
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crosscoder_tpu.data.buffer import make_buffer
+    from crosscoder_tpu.models import lm
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train.trainer import Trainer
+
+    tiny = os.environ.get("BENCH_TINY") == "1"    # CI/debug only
+    if tiny:
+        lm_cfg = lm.LMConfig.tiny()
+        # dict_size is deliberately large relative to the tiny LM: the leg
+        # needs the train step to dominate harvest compute per cycle, or
+        # there is no window to hide the refill in (on real TPUs the e2e
+        # config is train-dominated; see docs/SCALING.md cost model)
+        base = dict(
+            d_in=lm_cfg.d_model, dict_size=4096, batch_size=256,
+            buffer_mult=16, model_batch_size=4, norm_calib_batches=2,
+            seq_len=17, hook_point="blocks.2.hook_resid_pre",
+        )
+    else:
+        hook_layer = 14
+        # only the executed blocks' params, as in section_e2e
+        lm_cfg = lm.LMConfig.gemma2_2b().replace(n_layers=hook_layer)
+        base = dict(
+            batch_size=4096, buffer_mult=32, model_batch_size=4,
+            norm_calib_batches=8, seq_len=1024,
+            hook_point=f"blocks.{hook_layer}.hook_resid_pre",
+        )
+    n_dev = len(jax.devices())
+    mesh = mesh_lib.make_mesh(data_axis_size=n_dev, model_axis_size=1)
+    params = [lm.init_params(jax.random.key(i), lm_cfg) for i in (0, 1)]
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, lm_cfg.vocab_size,
+                          size=(2048, base["seq_len"]), dtype=np.int32)
+
+    n_steps = int(os.environ.get("BENCH_OVERLAP_STEPS", 48 if tiny else 32))
+    seg_saved = os.environ.get("CROSSCODER_SEG_LAYERS")
+    out: dict = {}
+    try:
+        # resolved at use time by SegmentedHarvest.seg_layers(): fine
+        # segmentation = many dispatch quanta/serve (the host-cost regime
+        # the overlap engine exists for), coarse = the device-bound regime
+        for seg in (3, 14):
+            os.environ["CROSSCODER_SEG_LAYERS"] = str(seg)
+            for ov in ("off", "on"):
+                cfg = _make_cfg(
+                    **base, num_tokens=10**12, save_every=10**9,
+                    prefetch=True, obs="on", refill_overlap=ov,
+                    checkpoint_dir=tempfile.mkdtemp(),
+                )
+                buffer = make_buffer(
+                    cfg, lm_cfg, params, tokens,
+                    batch_sharding=NamedSharding(mesh, P("data", None)),
+                )
+                trainer = Trainer(cfg, buffer, mesh=mesh)
+                m = trainer.step()            # compile both variants
+                _sync(m["loss"])
+                m = trainer.step(full_metrics=False)
+                _sync(m["loss"])
+                trainer._obs.take_blocked_s()   # reset the accumulator
+                # per-step sync on every step of both arms: the sync RTT
+                # cancels in the A/B, and per-step times expose the
+                # refresh spike as max - median
+                times = []
+                t0 = time.perf_counter()
+                for _ in range(n_steps):
+                    t1 = time.perf_counter()
+                    m = trainer.step(full_metrics=False)
+                    _sync(m["loss"])
+                    times.append(1000 * (time.perf_counter() - t1))
+                wall = time.perf_counter() - t0
+                blocked = trainer._obs.take_blocked_s()
+                trainer.close()
+                median_ms = sorted(times)[len(times) // 2]
+                leg = {
+                    "bubble_frac": round(min(1.0, blocked / wall), 4),
+                    "acts_per_sec_chip": round(
+                        cfg.batch_size * n_steps / wall / n_dev, 1),
+                    "step_ms_median": round(median_ms, 2),
+                    "step_ms_max": round(max(times), 2),
+                    "max_over_median": round(max(times) / median_ms, 2),
+                }
+                log(f"[refill_overlap] seg{seg} overlap={ov}: {leg}")
+                out[f"seg{seg}_{ov}"] = leg
+            on, off = out[f"seg{seg}_on"], out[f"seg{seg}_off"]
+            out[f"seg{seg}_gate_ok"] = bool(
+                on["bubble_frac"] <= 0.10
+                and on["acts_per_sec_chip"] >= off["acts_per_sec_chip"])
+    finally:
+        if seg_saved is None:
+            os.environ.pop("CROSSCODER_SEG_LAYERS", None)
+        else:
+            os.environ["CROSSCODER_SEG_LAYERS"] = seg_saved
+    out["n_steps_measured"] = n_steps
+    out["gate_ok"] = bool(out.get("seg3_gate_ok")
+                          and out.get("seg14_gate_ok"))
+    log(f"[refill_overlap] gate_ok={out['gate_ok']}")
+    return out
+
+
 def section_harvest() -> dict:
     """The LM-harvest side on a mixed-length synthetic corpus — the
     dominant per-step cost outside the crosscoder, invisible in every
@@ -1060,12 +1173,15 @@ def _run_sections() -> dict:
     except OSError:
         cache_state = "cold"
     sections = os.environ.get(
-        "BENCH_SECTIONS", "step,matrix,configs,e2e,harvest,quant,obs,dash"
+        "BENCH_SECTIONS",
+        "step,matrix,configs,e2e,refill_overlap,harvest,quant,obs,dash"
     ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
                      ("configs", section_configs),
-                     ("e2e", section_e2e), ("harvest", section_harvest),
+                     ("e2e", section_e2e),
+                     ("refill_overlap", section_refill_overlap),
+                     ("harvest", section_harvest),
                      ("quant", section_quant), ("obs", section_obs),
                      ("dash", section_dash)):
         if name not in sections:

@@ -206,9 +206,11 @@ KNOB_OFF_LATTICE: tuple[tuple[str, dict[str, Any]], ...] = (
     ("resilience", dict(guard_loss=True, harvest_timeout_s=2.0,
                         keep_saves=2)),
     ("logging", dict(log_backend="jsonl", profile_dir="/tmp/prof")),
+    ("refill_overlap", dict(refill_overlap="on", refill_dispatch_batch=8)),
     ("all_knobs", dict(quant_buffer=True, quant_block=8, obs="on",
                        harvest_runtime="paged", page_size=16, seq_len=1024,
-                       guard_loss=True, log_backend="jsonl")),
+                       guard_loss=True, log_backend="jsonl",
+                       refill_overlap="on", refill_dispatch_batch=8)),
 )
 
 # the sparse/fused tiers: "off" vs a dead "auto" (no kernel live) must be
@@ -279,6 +281,27 @@ def _check_identity(ctx: StepContext) -> list[Finding]:
                         f"{len(ctx.texts[b])} chars) — the zero-cost-off "
                         f"contract is broken",
             ))
+    return out
+
+
+def _check_refill_overlap_off(ctx: StepContext) -> list[Finding]:
+    """The zero-bubble refill engine is pure data plane: with
+    ``cfg.refill_overlap``/``refill_dispatch_batch`` set, the TRAIN STEP
+    must lower byte-identically to the bare baseline (docs/SCALING.md
+    "Zero-bubble refill") — the engine may only change how batches are
+    produced, never what the step computes. Split out from the generic
+    knob-off rule so the overlap contract has its own mutation self-test
+    and its own name in the report."""
+    out = []
+    for a, b, knob in ctx.identity_pairs:
+        if knob != "refill_overlap" or ctx.texts[a] == ctx.texts[b]:
+            continue
+        out.append(Finding(
+            rule="hlo-refill-overlap-off-identity", location=f"{a} vs {b}",
+            message="refill_overlap/refill_dispatch_batch changed the "
+                    "compiled step program — the overlap engine must be "
+                    "invisible to the step lowering",
+        ))
     return out
 
 
@@ -390,6 +413,9 @@ HLO_RULES: list[Rule] = [
     Rule("jaxpr-no-large-captured-consts",
          "the step jaxpr closes over no large concrete arrays",
          _is_step_ctx, _check_large_consts),
+    Rule("hlo-refill-overlap-off-identity",
+         "the refill overlap engine never changes the step lowering",
+         _is_step_ctx, _check_refill_overlap_off),
 ]
 
 

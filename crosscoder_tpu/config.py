@@ -302,6 +302,23 @@ class CrossCoderConfig:
     model_names: tuple[str, ...] = ()  # HF ids to diff; default: (google/<model_name>, +"-it")
     resume: bool = False            # resume from the latest checkpoint version
     prefetch: bool = True           # overlap host batch gather with the device step
+    refill_overlap: str = "off"     # off | on: zero-bubble refill engine
+                                    # (docs/SCALING.md "Zero-bubble
+                                    # refill"). "on" harvests refill
+                                    # cycles into spare store rows while
+                                    # the live rows serve (a logical→
+                                    # physical row map swaps at cycle
+                                    # boundaries — no data copy) and
+                                    # batches/offloads the harvest
+                                    # dispatch quanta; the served batch
+                                    # stream stays byte-identical. Costs
+                                    # ×(1 + refill_frac) store memory.
+    refill_dispatch_batch: int = 4  # refill_overlap="on" only: harvest
+                                    # dispatch quanta issued per Python
+                                    # dispatch (one wide sub-scan program
+                                    # instead of N narrow ones) — divides
+                                    # the ~6-8 ms/dispatch host cost on
+                                    # tunneled clients by this factor.
     stop_poll_every: int = 20       # multi-process only: steps between
                                     # allgathered stop-flag polls (the
                                     # SIGTERM coordinated stop). Each poll
@@ -638,6 +655,12 @@ class CrossCoderConfig:
         if self.stop_poll_every < 1:
             raise ValueError(
                 f"stop_poll_every must be >= 1, got {self.stop_poll_every}"
+            )
+        _check_choice("refill_overlap", self.refill_overlap, ("off", "on"))
+        if self.refill_dispatch_batch < 1:
+            raise ValueError(
+                f"refill_dispatch_batch must be >= 1 (harvest quanta fused "
+                f"per dispatch), got {self.refill_dispatch_batch}"
             )
         if self.loss_spike_factor <= 1.0:
             raise ValueError(

@@ -83,6 +83,10 @@ class _SingleDispatchJob:
     def step(self) -> bool:
         return False
 
+    def step_many(self, quanta: int) -> tuple[int, bool]:
+        # already dispatched in full: one quantum of the pacing budget
+        return 1, False
+
     def inflight(self):
         return [self._result]
 
@@ -115,6 +119,14 @@ class PairedActivationBuffer:
     # (device_get raises on cross-process-sharded arrays); the device/mesh
     # subclasses keep rows on device and override this
     _MULTIPROCESS_OK = False
+
+    # whether the overlap engine may offload its dispatch pump to a
+    # dedicated thread: the host store's drains touch only host memory in
+    # rows disjoint from everything the serve path reads, so the thread is
+    # safe; the device stores rebind a DONATED store array per scatter,
+    # which would race the serve gather's read of that binding on async
+    # backends — they pump inline instead (still batched)
+    _DISPATCH_THREAD_OK = True
 
     def _pipelined(self, produced, drain) -> None:
         pipeline.drive(produced, drain, depth=self.PIPELINE_DEPTH)
@@ -204,6 +216,31 @@ class PairedActivationBuffer:
         self._paged_valid_tokens = 0    # padding-efficiency telemetry
         self._paged_total_tokens = 0
 
+        # zero-bubble refill (cfg.refill_overlap="on"; docs/SCALING.md
+        # "Zero-bubble refill"): steady-state cycles harvest into SPARE
+        # physical rows while the live rows keep serving, and a logical→
+        # physical row map swaps at the cycle boundary — pure index
+        # bookkeeping, no data movement. _spare_rows equals the steady-
+        # state refill target, so one shadow cycle always fits; full
+        # fills (first fill, restore) exceed it and take the baseline
+        # in-place path. Store memory grows ×(1 + refill_frac).
+        self._overlap = cfg.refill_overlap == "on"
+        self._spare_rows = (
+            self._refill_batches() * rows_per_seq if self._overlap else 0
+        )
+        self._store_rows = self.buffer_size + self._spare_rows
+        self._row_map = np.arange(self.buffer_size)
+        self._free_rows = self.buffer_size + np.arange(self._spare_rows)
+        # batched/offloaded dispatch: a dedicated thread spends the
+        # pacing credit so the ~6-8 ms/dispatch host cost never sits on
+        # the serve path. Single-process only — the thread's timing is
+        # host-local, so on a multi-process mesh the same pump runs
+        # inline in _advance_cycle (count-based, SPMD-consistent).
+        self._dispatcher = None
+        if (self._overlap and self._DISPATCH_THREAD_OK
+                and jax.process_count() == 1):
+            self._dispatcher = pipeline.QuantumDispatcher(self._pump_locked)
+
         self._alloc_store()
         self._perm = np.arange(self.buffer_size)
         self._rng = np.random.default_rng(cfg.seed)
@@ -223,8 +260,10 @@ class PairedActivationBuffer:
             self.refresh()
 
     def _alloc_store(self) -> None:
+        # _store_rows = buffer_size + the overlap engine's spare region
+        # (equal to buffer_size with refill_overlap off)
         self._store = np.empty(
-            (self.buffer_size, self.cfg.n_sources, self.cfg.d_in), dtype=_BF16
+            (self._store_rows, self.cfg.n_sources, self.cfg.d_in), dtype=_BF16
         )
 
     def store_nbytes(self) -> int:
@@ -376,6 +415,7 @@ class PairedActivationBuffer:
         reference's multi-second stall every ~63 steps (reference
         ``buffer.py:121-122``) becomes a sub-batch-sized bubble.
         """
+        self._quiesce_dispatch()
         num_batches = (
             self.buffer_batches if self.first else self._refill_batches()
         )
@@ -459,6 +499,19 @@ class PairedActivationBuffer:
         n_chunks = -(-num_batches // self._chunk_seqs)
         serves = max(1, trigger // b + 1)
         self._cyc_segs_per_serve = -(-n_chunks * self._segs_per_chunk() // serves)
+        # shadow cycle (overlap engine): the cycle's rows land in spare
+        # physical rows instead of in-place, so drains need no write-
+        # safety gate and the swap at _finish_cycle is pure bookkeeping.
+        # Only steady-state cycles fit the spare region; full fills keep
+        # the baseline in-place path (and its linear write order).
+        self._cyc_shadow = self._overlap and self._cyc_target <= self._spare_rows
+        self._cyc_phys = (
+            self._free_rows[: self._cyc_target] if self._cyc_shadow else None
+        )
+        # deferred provenance (see _record_src): applied at the swap
+        self._cyc_src = (
+            np.empty(self._cyc_target, np.int64) if self._cyc_shadow else None
+        )
 
     def _segs_per_chunk(self) -> int:
         """Dispatch quanta one harvest chunk costs (pacing denominator)."""
@@ -486,12 +539,34 @@ class PairedActivationBuffer:
             out_dtype=jnp.bfloat16,
         )
 
-    def _cyc_positions(self, woff: int, n_rows: int) -> np.ndarray:
-        """Store positions for cycle write offsets [woff, woff+n_rows):
+    def _cyc_logical(self, woff: int, n_rows: int) -> np.ndarray:
+        """LOGICAL store rows for cycle write offsets [woff, woff+n_rows):
         serve-order index = rot + j for the tail writes, j − tail after."""
         j = np.arange(woff, woff + n_rows)
         order = np.where(j < self._cyc_tail, self._cyc_rot + j, j - self._cyc_tail)
         return self._perm[order]
+
+    def _cyc_positions(self, woff: int, n_rows: int) -> np.ndarray:
+        """PHYSICAL rows the drain scatters to: a shadow cycle's reserved
+        spare rows; otherwise the live physical rows of the logical
+        targets (``_row_map`` is the identity with overlap off)."""
+        if self._cyc_shadow:
+            return self._cyc_phys[woff: woff + n_rows]
+        logical = self._cyc_logical(woff, n_rows)
+        return self._row_map[logical] if self._overlap else logical
+
+    def _record_src(self, woff: int, n_rows: int,
+                    seq_globals: np.ndarray) -> None:
+        """Per-row provenance for one drained chunk. A shadow cycle defers
+        it to the swap (``_finish_cycle``): its data only becomes the
+        logical content there, so an abandoned shadow cycle must leave
+        ``_src_global`` — and the suffix-min resume snapshot derived from
+        it — untouched."""
+        src = np.repeat(seq_globals, self.cfg.seq_len - 1)
+        if self._cyc_shadow:
+            self._cyc_src[woff: woff + n_rows] = src
+        else:
+            self._src_global[self._cyc_logical(woff, n_rows)] = src
 
     def _create_job(self) -> tuple:
         """Open the next chunk's harvest job (dispatches nothing yet) and
@@ -529,24 +604,88 @@ class PairedActivationBuffer:
 
     def _drain_one(self) -> None:
         cfg = self.cfg
-        rows_per_seq = cfg.seq_len - 1
         acts_dev, n, seq_globals, woff = self._cyc_inflight.pop(0)
         acts = np.asarray(jax.device_get(acts_dev))[:n]
         acts = acts[:, 1:]                              # drop BOS (buffer.py:93)
         rows = acts.reshape(-1, cfg.n_sources, cfg.d_in)
         positions = self._cyc_positions(woff, rows.shape[0])
         native.scatter_rows(self._store, positions, rows)
-        self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
+        self._record_src(woff, rows.shape[0], seq_globals)
         self._cyc_drained += rows.shape[0]
 
     def _head_drainable(self) -> bool:
         """Write-safety check for the OLDEST in-flight chunk: its store
         positions are freed once the serve pointer (plus the static tail)
-        covers its write extent."""
+        covers its write extent. A shadow cycle writes only spare rows —
+        nothing to protect — so it keeps just a one-chunk drain lag
+        (device compute overlaps the fetch/scatter of the previous chunk;
+        count-based, so every process decides identically)."""
         if not self._cyc_inflight:
             return False
+        if self._cyc_shadow:
+            return len(self._cyc_inflight) > 1
         _, n, _, woff = self._cyc_inflight[0]
         return woff + n * (self.cfg.seq_len - 1) <= self.pointer + self._cyc_tail
+
+    def _dispatch_quanta(self, quanta: int) -> int:
+        """Spend up to ``quanta`` dispatch credit on the harvest pipeline
+        as ONE batched sub-scan program (``cfg.refill_dispatch_batch``
+        quanta fused per Python dispatch — the sequential scan carry makes
+        a k-wide sub-scan bitwise identical to k narrow ones, so only the
+        per-dispatch host cost divides). Returns the credit actually
+        spent; 0 when nothing is dispatchable right now (cycle fully
+        dispatched, or the in-flight window is full)."""
+        if self._cyc_job is None:
+            if (self._cyc_seq_done >= self._cyc_batches
+                    or len(self._cyc_inflight) + 1 > self.PIPELINE_DEPTH):
+                return 0
+            self._cyc_job = self._create_job()
+        job, n, seq_globals, woff = self._cyc_job
+        used, alive = job.step_many(
+            min(quanta, max(1, self.cfg.refill_dispatch_batch))
+        )
+        pipeline.finish_on_cpu(job.inflight())
+        if not alive:
+            self._cyc_inflight.append((job.result(), n, seq_globals, woff))
+            self._cyc_job = None
+        return max(used, 1)
+
+    def _overlap_pump(self, credit: int) -> None:
+        """Shadow-cycle refill progress: spend ``credit`` dispatch quanta
+        (batched) and land every finished chunk past the count-based
+        drain lag. The caller holds the program guard (the dispatcher
+        thread enters through :meth:`_pump_locked`)."""
+        # span site (docs/OBSERVABILITY.md): one credit grant's dispatch +
+        # drain work — on the refill-dispatch thread when offloaded, on
+        # the serve thread when pumped inline (multi-process)
+        with trace.span("refill_dispatch", credit=credit):
+            while credit > 0:
+                used = self._dispatch_quanta(credit)
+                if used == 0:
+                    break
+                credit -= used
+            while self._head_drainable():
+                with trace.span("harvest"):
+                    self._drain_one()
+
+    def _pump_locked(self, credit: int) -> None:
+        with pipeline.sharded_program_guard():
+            self._overlap_pump(credit)
+
+    def _quiesce_dispatch(self) -> None:
+        """Wait out any offloaded refill work before mutating cycle state
+        under the dispatcher's feet (forced refresh, restore); re-raises
+        any harvest error the dispatcher thread hit."""
+        if getattr(self, "_dispatcher", None) is not None:
+            self._dispatcher.drain()
+
+    def close(self) -> None:
+        """Stop the refill dispatcher thread (a no-op with overlap off or
+        on a device store). Idempotent; swallows in-flight work — callers
+        tear the buffer down after this."""
+        if getattr(self, "_dispatcher", None) is not None:
+            self._dispatcher.close()
+            self._dispatcher = None
 
     def _advance_cycle(self) -> None:
         """One serve's worth of refill progress: dispatch the paced number
@@ -559,8 +698,19 @@ class PairedActivationBuffer:
         offsets, depth, the credit counter), so every process of a
         multi-process mesh makes identical dispatch/drain choices — the
         SPMD rendezvous-order requirement that ruled out the old
-        is_ready() opportunistic drain.
+        is_ready() opportunistic drain. The overlap engine keeps this:
+        the shadow path's dispatch/drain schedule is the same count-based
+        function of the credit stream; only WHICH thread runs it moves
+        (the dispatcher thread exists in single-process mode only).
         """
+        if self._cyc_shadow:
+            credit = self._cyc_segs_per_serve
+            if self._dispatcher is not None:
+                self._dispatcher.submit(credit)
+            else:
+                with pipeline.sharded_program_guard():
+                    self._overlap_pump(credit)
+            return
         with pipeline.sharded_program_guard():
             credit = self._cyc_segs_per_serve
             while credit > 0 and self._step_job():
@@ -580,17 +730,38 @@ class PairedActivationBuffer:
         The ``refill`` span here brackets the serve-trigger completion —
         the residual refill bubble the incremental dispatches exist to
         amortize, now directly visible per cycle in the trace."""
+        if self._cyc_shadow and self._dispatcher is not None:
+            # quiesce BEFORE taking the guard: the dispatcher thread takes
+            # the guard inside its pump, and the serve thread never holds
+            # it here, so there is no lock-ordering cycle
+            self._dispatcher.drain()
         with trace.span("refill", target_rows=self._cyc_target), \
                 pipeline.sharded_program_guard():
             while (self._cyc_seq_done < self._cyc_batches
                    or self._cyc_job is not None):
-                if not self._step_job():    # depth window full: free a slot
+                advanced = (self._dispatch_quanta(1 << 30) if self._cyc_shadow
+                            else self._step_job())
+                if not advanced:            # depth window full: free a slot
                     with trace.span("harvest"):
                         self._drain_one()
             while self._cyc_inflight:
                 with trace.span("harvest"):
                     self._drain_one()
         assert self._cyc_drained == self._cyc_write == self._cyc_target
+        if self._cyc_shadow:
+            # THE SWAP: the shadow rows become the logical content and the
+            # displaced live rows become the next cycle's spare region —
+            # pure index bookkeeping, no row bytes move. Logical row
+            # _perm[order(j)] now maps to the physical row holding cycle
+            # row j, exactly the row the baseline in-place path would have
+            # written there: the served stream is byte-identical.
+            logical = self._cyc_logical(0, self._cyc_target)
+            old_phys = self._row_map[logical].copy()
+            self._row_map[logical] = self._cyc_phys
+            self._free_rows = np.concatenate(
+                [old_phys, self._free_rows[self._cyc_target:]]
+            )
+            self._src_global[logical] = self._cyc_src
         self._cyc_seq_done = 0      # cycle consumed: nothing left to abandon
         self._perm = self._rng.permutation(self.buffer_size)
         self.pointer = 0
@@ -629,6 +800,8 @@ class PairedActivationBuffer:
             )
         idx = self._perm[self.pointer: self.pointer + cfg.batch_size]
         self.pointer += cfg.batch_size
+        if self._overlap:
+            idx = self._row_map[idx]    # logical → physical (identity off)
         return idx
 
     def next(self) -> np.ndarray:
@@ -695,13 +868,19 @@ class PairedActivationBuffer:
         # the restored stream position supersedes any live cycle: drop its
         # chunks WITHOUT the abandon-rewind (that would shift the restored
         # pointer by sequences belonging to the pre-restore stream)
+        self._quiesce_dispatch()
         self._cyc_inflight = []
         self._cyc_job = None
         self._cyc_seq_done = 0
         # restore must be independent of pre-restore buffer history: reset
         # the permutation so the refill lands rows in harvest order, exactly
-        # as a freshly-constructed buffer's restore does (determinism A2)
+        # as a freshly-constructed buffer's restore does (determinism A2) —
+        # and, under the overlap engine, reset the row map/spare region the
+        # same way (the restore's full fill writes logical == physical)
         self._perm = np.arange(self.buffer_size)
+        if self._overlap:
+            self._row_map = np.arange(self.buffer_size)
+            self._free_rows = self.buffer_size + np.arange(self._spare_rows)
         self.token_pointer = int(state["token_pointer"])
         self._global_seq = self.token_pointer
         self._rng.bit_generator.state = state["rng_state"]
@@ -803,17 +982,19 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
     """
 
     _MULTIPROCESS_OK = True
+    _DISPATCH_THREAD_OK = False     # donated-scatter rebind vs serve gather
 
     def _alloc_store(self) -> None:
         cfg = self.cfg
         self._store_dev = jnp.zeros(
-            (self.buffer_size, cfg.n_sources, cfg.d_in), dtype=jnp.bfloat16
+            (self._store_rows, cfg.n_sources, cfg.d_in), dtype=jnp.bfloat16
         )
 
     @property
     def _store(self) -> np.ndarray:
-        """Host view (tests/analysis only — fetches the whole store)."""
-        return np.asarray(jax.device_get(self._store_dev))
+        """LOGICAL host view (tests/analysis only — fetches the whole
+        store; the row map resolves overlap-mode physical placement)."""
+        return np.asarray(jax.device_get(self._store_dev))[self._row_map]
 
     def store_nbytes(self) -> int:
         return self._store_dev.nbytes
@@ -823,7 +1004,7 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
     def _pad_limit(self) -> int:
         """First index guaranteed out of range of the device store — pad
         scatter positions start here so they are always dropped."""
-        return self.buffer_size
+        return self._store_rows
 
     def _scatter_chunk(self, positions: np.ndarray, acts_dev: jax.Array) -> None:
         self._store_dev = _dev_scatter(
@@ -856,9 +1037,7 @@ class DevicePairedActivationBuffer(PairedActivationBuffer):
                         getattr(self, "_store_scale", None))
             if a is not None
         ])
-        self._src_global[positions[: n * rows_per_seq]] = np.repeat(
-            seq_globals, rows_per_seq
-        )
+        self._record_src(woff, n * rows_per_seq, seq_globals)
         self._cyc_drained += n * rows_per_seq
 
     def next(self) -> jax.Array:
@@ -996,7 +1175,7 @@ class MeshPairedActivationBuffer(DevicePairedActivationBuffer):
                 f"the mesh data axis {n_shards} for the batch-sharded "
                 f"scatter (model_batch_size={cfg.model_batch_size})"
             )
-        self._rows_local = -(-self.buffer_size // n_shards)
+        self._rows_local = -(-self._store_rows // n_shards)
         self._store_size = self._rows_local * n_shards
         # under seq-parallel harvest the data axis carries the sequence, so
         # chunks arrive without a batch sharding — use the replicated-acts
@@ -1028,8 +1207,9 @@ class MeshPairedActivationBuffer(DevicePairedActivationBuffer):
 
     @property
     def _store(self) -> np.ndarray:
-        """Host view (tests/analysis only — fetches the whole store)."""
-        return np.asarray(jax.device_get(self._store_dev))[: self.buffer_size]
+        """LOGICAL host view (tests/analysis only — fetches the whole
+        store)."""
+        return np.asarray(jax.device_get(self._store_dev))[self._row_map]
 
     def _pad_limit(self) -> int:
         # pad indices must clear the PADDED store so no shard keeps them
@@ -1125,18 +1305,19 @@ class QuantPairedActivationBuffer(PairedActivationBuffer):
         quant = _quant_module()
         nb = quant.n_blocks(cfg.d_in, cfg.quant_block)
         self._store_q = np.zeros(
-            (self.buffer_size, cfg.n_sources, cfg.d_in), np.int8
+            (self._store_rows, cfg.n_sources, cfg.d_in), np.int8
         )
         self._store_scale = np.zeros(
-            (self.buffer_size, cfg.n_sources, nb), np.float32
+            (self._store_rows, cfg.n_sources, nb), np.float32
         )
 
     @property
     def _store(self) -> np.ndarray:
-        """Dequantized bf16 view (tests/analysis only — materializes the
-        whole store)."""
+        """Dequantized LOGICAL bf16 view (tests/analysis only —
+        materializes the whole store)."""
         return _quant_module().dequantize_np(
-            self._store_q, self._store_scale, _BF16
+            self._store_q[self._row_map], self._store_scale[self._row_map],
+            _BF16,
         )
 
     def store_nbytes(self) -> int:
@@ -1144,7 +1325,6 @@ class QuantPairedActivationBuffer(PairedActivationBuffer):
 
     def _drain_one(self) -> None:
         cfg = self.cfg
-        rows_per_seq = cfg.seq_len - 1
         acts_dev, n, seq_globals, woff = self._cyc_inflight.pop(0)
         # quantize ON DEVICE, then fetch int8+scales: the chunk's
         # device→host bytes drop ~2x before they touch the link
@@ -1156,7 +1336,7 @@ class QuantPairedActivationBuffer(PairedActivationBuffer):
         positions = self._cyc_positions(woff, rows_q.shape[0])
         self._store_q[positions] = rows_q
         self._store_scale[positions] = rows_s
-        self._src_global[positions] = np.repeat(seq_globals, rows_per_seq)
+        self._record_src(woff, rows_q.shape[0], seq_globals)
         self._cyc_drained += rows_q.shape[0]
 
     def _gather_dequant(self, idx: np.ndarray, dtype) -> np.ndarray:
@@ -1192,18 +1372,18 @@ class QuantDevicePairedActivationBuffer(DevicePairedActivationBuffer):
         quant = _quant_module()
         nb = quant.n_blocks(cfg.d_in, cfg.quant_block)
         self._store_q = jnp.zeros(
-            (self.buffer_size, cfg.n_sources, cfg.d_in), jnp.int8
+            (self._store_rows, cfg.n_sources, cfg.d_in), jnp.int8
         )
         self._store_scale = jnp.zeros(
-            (self.buffer_size, cfg.n_sources, nb), jnp.float32
+            (self._store_rows, cfg.n_sources, nb), jnp.float32
         )
 
     @property
     def _store(self) -> np.ndarray:
-        """Dequantized host view (tests/analysis only)."""
+        """Dequantized LOGICAL host view (tests/analysis only)."""
         return _quant_module().dequantize_np(
-            np.asarray(jax.device_get(self._store_q)),
-            np.asarray(jax.device_get(self._store_scale)),
+            np.asarray(jax.device_get(self._store_q))[self._row_map],
+            np.asarray(jax.device_get(self._store_scale))[self._row_map],
             _BF16,
         )
 
@@ -1314,10 +1494,10 @@ class QuantMeshPairedActivationBuffer(MeshPairedActivationBuffer):
 
     @property
     def _store(self) -> np.ndarray:
-        """Dequantized host view (tests/analysis only)."""
+        """Dequantized LOGICAL host view (tests/analysis only)."""
         return _quant_module().dequantize_np(
-            np.asarray(jax.device_get(self._store_q))[: self.buffer_size],
-            np.asarray(jax.device_get(self._store_scale))[: self.buffer_size],
+            np.asarray(jax.device_get(self._store_q))[self._row_map],
+            np.asarray(jax.device_get(self._store_scale))[self._row_map],
             _BF16,
         )
 

@@ -784,6 +784,76 @@ class SegmentedHarvest:
                 return False
         return True
 
+    def _scan_batched(self, k: int):
+        """One ``k``-wide sub-scan dispatch through a pre-built donated
+        executable (utils/compile_cache.aot_get): the AOT compile happens
+        once per width, off the per-quantum path, and later dispatches
+        skip the jit call machinery — the host-cost half of the refill
+        engine's batched dispatch. Any AOT failure falls back to the
+        plain jit call (same program, just dispatched the ordinary way)."""
+        from crosscoder_tpu.utils import compile_cache
+
+        params = self.params_seq[self._model_idx]
+        args = (params, self._resid, self._buf, jnp.int32(self._lo))
+        key = ("seg_scan", self.cfg, self.capture, k, self.tokens.shape,
+               str(self._resid.dtype),
+               getattr(self._resid, "sharding", None),
+               getattr(params["embed"], "sharding", None))
+        try:
+            compiled = compile_cache.aot_get(
+                key,
+                lambda: _seg_scan_impl.lower(
+                    *args, cfg=self.cfg, capture=self.capture, k=k
+                ).compile(),
+            )
+        except Exception:   # noqa: BLE001 — AOT is an optimization only
+            compiled = None
+        if compiled is None:
+            return _seg_scan_impl(*args, cfg=self.cfg, capture=self.capture, k=k)
+        return compiled(*args)
+
+    def step_many(self, quanta: int) -> tuple[int, bool]:
+        """Advance by up to ``quanta`` dispatch quanta, FUSING consecutive
+        same-model quanta into one wide sub-scan dispatch (``k`` up to
+        ``quanta × SEG_LAYERS`` layers in a single compiled program) —
+        the refill engine's batched dispatch (cfg.refill_dispatch_batch).
+
+        Returns ``(quanta_consumed, alive)`` with the same accounting as
+        ``quanta_consumed`` calls to :meth:`step`: the scan carry is
+        sequential, so a k-wide sub-scan is bitwise identical to k/SEG
+        narrow ones (asserted by tests/test_refill_overlap.py).
+        """
+        used = 0
+        while used < quanta:
+            if self._out is not None:
+                return used, False
+            if self._resid is None:
+                self._resid, self._buf = _seg_start_impl(
+                    self.params_seq[self._model_idx], self.tokens, self.cfg,
+                    len(self.capture),
+                )
+            if self._lo < self.n_scan:
+                n_q = min(quanta - used,
+                          -(-(self.n_scan - self._lo) // self._seg_layers))
+                k = min(n_q * self._seg_layers, self.n_scan - self._lo)
+                self._resid, self._buf = self._scan_batched(k)
+                self._lo += k
+                used += n_q
+            if self._lo >= self.n_scan:
+                self._done_resids.append(self._resid)
+                self._done_bufs.append(self._buf)
+                self._resid = self._buf = None
+                self._lo = 0
+                self._model_idx += 1
+                if self._model_idx == len(self.params_seq):
+                    self._out = _seg_finish_impl(
+                        tuple(self._done_resids), tuple(self._done_bufs),
+                        self.cfg, self.capture, self.n_scan, self.out_dtype,
+                    )
+                    self._done_resids = self._done_bufs = []
+                    return used, False
+        return used, True
+
     def result(self) -> jax.Array:
         while self._out is None:
             self.step()

@@ -27,13 +27,21 @@ data plane — sharded harvest → mesh-sharded HBM replay store → train step
 stop/save path. Two SPMD dispatch-order rules the framework enforces for
 multi-process runs (violations deadlock cross-host rendezvous):
 
-- the trainer's prefetch worker is disabled (its serve gather would race
-  the main thread's step differently per host) — ``Trainer.__init__``;
+- the trainer's prefetch worker runs under a ticketed launch sequencer
+  (``utils/pipeline.LaunchSequencer``): every launch site — the worker's
+  serve gather + batch upload, the step/resample dispatch, the stop-flag
+  allgather — reserves a ticket on the main thread in program order
+  (identical across processes) and executes under that ticket's turn, so
+  the cross-host enqueue order is fixed even though the launches run on
+  two threads (:func:`needs_launch_tickets` is the gate);
 - the buffer's refill dispatch/drain schedule derives ONLY from
   host-replicated state (serve pointer, write offsets, the per-serve
-  dispatch credit — ``_advance_cycle``/``_head_drainable``), never from
-  host-local timing, so every process dispatches the same harvest
-  segments and collective scatters in the same order.
+  dispatch credit — ``_advance_cycle``/``_head_drainable``; overlap mode
+  uses count-based drain lag), never from host-local timing, so every
+  process dispatches the same harvest segments and collective scatters
+  in the same order. The refill engine's dedicated dispatcher thread is
+  single-process-only for the same reason (its timing is host-local);
+  multi-process overlap runs the same pump inline in the serve path.
 """
 
 from __future__ import annotations
@@ -68,6 +76,16 @@ def initialize(
         num_processes=num_processes,
         process_id=process_id,
     )
+    return jax.process_count() > 1
+
+
+def needs_launch_tickets() -> bool:
+    """True when concurrent program launches must be ordered through a
+    :class:`crosscoder_tpu.utils.pipeline.LaunchSequencer`: a mesh spanning
+    processes makes enqueue order part of SPMD correctness (every process
+    must enqueue the same collectives in the same order). Single-process
+    runs return False — any interleaving is correct there, and the
+    sequencer would only serialize launches for nothing."""
     return jax.process_count() > 1
 
 

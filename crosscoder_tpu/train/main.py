@@ -104,10 +104,17 @@ def main(argv: list[str] | None = None) -> Trainer:
         checkpointer=Checkpointer(cfg=cfg, chaos=chaos),
         chaos=chaos,
     )
-    if cfg.resume:
-        meta = trainer.restore()
-        print(f"[crosscoder_tpu] resumed at step {meta['step']}", file=sys.stderr)
-    trainer.train()
+    try:
+        if cfg.resume:
+            meta = trainer.restore()
+            print(f"[crosscoder_tpu] resumed at step {meta['step']}", file=sys.stderr)
+        trainer.train()
+    finally:
+        # train() closes on its own exits, but a restore() failure — or an
+        # exception before the loop ever starts — must still release the
+        # worker threads (prefetch pool, the buffer's refill dispatcher)
+        # and land background writes; close() is idempotent
+        trainer.close()
     return trainer
 
 

@@ -44,6 +44,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.models import crosscoder as cc
 from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.parallel import multihost
 from crosscoder_tpu.train import schedules
 from crosscoder_tpu.train.state import TrainState, init_train_state, make_optimizer
 from crosscoder_tpu.utils import pipeline
@@ -434,16 +435,22 @@ class Trainer:
         # dispatches intentionally stay concurrent with steps — but the
         # trainer's two per-step enqueues are cheap to serialize.
         self._dispatch_lock = threading.Lock()
-        if cfg.prefetch and jax.process_count() > 1:
-            # multi-process SPMD requires every process to enqueue the same
-            # programs in the same order; a prefetch thread races its
-            # (collective) serve gather against the main thread's step
-            # differently on each host — a cross-process rendezvous
-            # mismatch. Serve synchronously instead.
-            print("[crosscoder_tpu] prefetch disabled on a multi-process "
-                  "mesh (nondeterministic cross-host dispatch order)",
-                  flush=True, file=sys.stderr)
-        elif cfg.prefetch:
+        # multi-process SPMD requires every process to enqueue the same
+        # programs in the same order; a prefetch thread racing its
+        # (collective) serve gather against the main thread's step would
+        # resolve differently on each host — a cross-process rendezvous
+        # mismatch. Historically that disabled prefetch on pods; the
+        # launch sequencer fixes the ORDER instead: every launch site
+        # reserves a ticket on the main thread in program order (identical
+        # across processes by SPMD construction) and executes under that
+        # ticket's turn (utils/pipeline.LaunchSequencer).
+        self._sequencer = None
+        if cfg.prefetch:
+            if multihost.needs_launch_tickets():
+                self._sequencer = pipeline.LaunchSequencer()
+                print("[crosscoder_tpu] multi-process prefetch: program "
+                      "launches run under ticketed dispatch ordering",
+                      flush=True, file=sys.stderr)
             self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="batch-prefetch"
             )
@@ -528,7 +535,23 @@ class Trainer:
             batch = self.chaos.poison_batch(batch, serve)
         return batch
 
-    def _produce_batch(self) -> tuple[jax.Array, jax.Array]:
+    def _reserve_ticket(self) -> int | None:
+        """Claim the next pod-wide launch slot. None without a sequencer
+        (single-process, or prefetch off): only one thread launches there,
+        so program order needs no tickets."""
+        if self._sequencer is None:
+            return None
+        return self._sequencer.reserve()
+
+    def _launch_turn(self, ticket: int | None):
+        """Context for executing launches under a reserved slot (a
+        nullcontext for ``ticket=None`` — the zero-cost single-process
+        path)."""
+        if ticket is None:
+            return contextlib.nullcontext()
+        return self._sequencer.turn(ticket)
+
+    def _produce_batch(self, ticket: int | None = None) -> tuple[jax.Array, jax.Array]:
         """Gather the next batch and start its host→device transfer.
 
         Runs on the prefetch worker when prefetching is on. Raw-bf16 serving
@@ -536,21 +559,25 @@ class Trainer:
         applied inside the compiled step. With ``cfg.harvest_timeout_s``
         set, the serve runs under the watchdog (stall detection + backoff
         retry of exceptions; chaos faults raise/stall at the serve's entry,
-        before buffer state moves, so a retried serve is safe).
+        before buffer state moves, so a retried serve is safe). On a
+        ticketed (multi-process) run the whole production executes under
+        its reserved launch slot — the serve gather's collectives then
+        land in the pod-wide enqueue order the ticket fixed.
         """
-        serve = self._serve_count
-        self._serve_count += 1
-        if self._watchdog is not None:
-            batch = self._watchdog.call(lambda: self._serve_once(serve))
-        else:
-            batch = self._serve_once(serve)
-        if self._obs is not None:
-            # measured transfer accounting (comm/*): one host→device batch
-            # upload per produced batch (a no-op put for device-resident
-            # stores — still the serve path's dispatch, counted as such)
-            self._obs.registry.count("comm/h2d_transfers")
-        with self._dispatch_lock:
-            return jax.device_put(batch, self._batch_sharding), self._device_scale()
+        with self._launch_turn(ticket):
+            serve = self._serve_count
+            self._serve_count += 1
+            if self._watchdog is not None:
+                batch = self._watchdog.call(lambda: self._serve_once(serve))
+            else:
+                batch = self._serve_once(serve)
+            if self._obs is not None:
+                # measured transfer accounting (comm/*): one host→device batch
+                # upload per produced batch (a no-op put for device-resident
+                # stores — still the serve path's dispatch, counted as such)
+                self._obs.registry.count("comm/h2d_transfers")
+            with self._dispatch_lock:
+                return jax.device_put(batch, self._batch_sharding), self._device_scale()
 
     def _submit_prefetch(self) -> None:
         # Stream-state snapshot BEFORE producing the next batch: a checkpoint
@@ -559,16 +586,31 @@ class Trainer:
         # is quiescent here — the previous production was just consumed).
         if hasattr(self.buffer, "state_dict"):
             self._buffer_snapshot = self.buffer.state_dict()
-        self._pending = self._prefetch_pool.submit(self._produce_batch)
+        ticket = self._reserve_ticket()
+        try:
+            self._pending = self._prefetch_pool.submit(self._produce_batch, ticket)
+        except BaseException:
+            if ticket is not None:
+                # a reservation that never runs would wedge every later
+                # turn — release it before propagating
+                self._sequencer.skip(ticket)
+            raise
 
-    def _next_batch(self) -> tuple[jax.Array, jax.Array]:
+    def _next_batch(self) -> tuple[tuple[jax.Array, jax.Array], int | None]:
+        """The consumed batch plus the launch ticket for the step that will
+        train on it (None on unticketed runs)."""
         if self._prefetch_pool is None:
-            return self._produce_batch()
+            return self._produce_batch(), self._reserve_ticket()
         if self._pending is None:
             self._submit_prefetch()
         out = self._pending.result()
+        # reserve the step's launch slot BEFORE submitting the next
+        # production: the step's enqueue then precedes the worker's in the
+        # pod-wide launch order, so the production overlaps the step's
+        # device execution instead of serializing in front of it
+        ticket = self._reserve_ticket()
         self._submit_prefetch()
-        return out
+        return out, ticket
 
     def _drain_prefetch(self, discard: bool = False) -> None:
         """Wait for in-flight batch production so buffer state is quiescent
@@ -584,9 +626,12 @@ class Trainer:
         awaited — it may hide a multi-second half-buffer re-harvest whose
         result would be thrown away (restore) or never consumed (final
         save); on successful cancel the live buffer state IS the snapshot.
+        Ticketed (multi-process) runs never cancel: cancel-if-not-started
+        is thread-timing dependent, so it would diverge per process (and
+        leak the production's reserved ticket, wedging every later turn).
         """
         if self._pending is not None:
-            if self._pending.cancel():
+            if self._sequencer is None and self._pending.cancel():
                 self._pending = None
                 self._buffer_snapshot = None
                 return
@@ -600,18 +645,27 @@ class Trainer:
                     self._buffer_snapshot = None
 
     def close(self) -> None:
+        """Release worker threads and land background writes. Idempotent:
+        train() closes in its ``finally`` and main()'s own try/finally
+        closes again on early exits — the second call is a no-op."""
         if self._prefetch_pool is not None:
             self._prefetch_pool.shutdown(wait=True)
             self._prefetch_pool = None
             self._pending = None
+        if hasattr(self.buffer, "close"):
+            # stop the buffer's refill dispatcher thread (overlap engine;
+            # a no-op with refill_overlap off — buffer.close is idempotent)
+            self.buffer.close()
         if self._watchdog is not None:
             self._watchdog.close()
+            self._watchdog = None
         if self.checkpointer is not None and hasattr(self.checkpointer, "wait"):
             # land any background checkpoint write before process exit
             self.checkpointer.wait()
         if self._obs is not None:
             # write the trace file and hand the process-global tracer back
             self._obs.close()
+            self._obs = None
 
     def step(self, full_metrics: bool = True) -> dict[str, jax.Array]:
         """One optimizer step; returns device-resident metrics (no sync).
@@ -647,43 +701,49 @@ class Trainer:
             # (the bubble); with it off, the full production time.
             t_wait = time.perf_counter_ns()
             with self._obs.tracer.span("refill_wait"):
-                batch, scale = self._next_batch()
+                (batch, scale), ticket = self._next_batch()
             self._obs.add_blocked_ns(time.perf_counter_ns() - t_wait)
         else:
-            batch, scale = self._next_batch()
-        n_resampled = None
-        if (cfg.resample_every > 0 and self._host_step > 0
-                and self._host_step % cfg.resample_every == 0):
-            # dead-latent resampling on the batch about to be trained on
-            # (train/resample.py); runs BEFORE the step so the revived
-            # latents' first gradients come from this same batch
-            if getattr(self, "_resample_fn", None) is None:
-                from crosscoder_tpu.train.resample import make_resample_fn
+            (batch, scale), ticket = self._next_batch()
+        # the resample + step launches run under this step's reserved
+        # launch slot on ticketed (multi-process) runs — a nullcontext
+        # otherwise. Lock order: turn (outermost) → dispatch lock → guard;
+        # the worker takes its own turn before the dispatch lock too, so
+        # the ordering is acyclic.
+        with self._launch_turn(ticket):
+            n_resampled = None
+            if (cfg.resample_every > 0 and self._host_step > 0
+                    and self._host_step % cfg.resample_every == 0):
+                # dead-latent resampling on the batch about to be trained on
+                # (train/resample.py); runs BEFORE the step so the revived
+                # latents' first gradients come from this same batch
+                if getattr(self, "_resample_fn", None) is None:
+                    from crosscoder_tpu.train.resample import make_resample_fn
 
-                self._resample_fn = make_resample_fn(
-                    cfg, self.mesh, self._state_shardings
+                    self._resample_fn = make_resample_fn(
+                        cfg, self.mesh, self._state_shardings
+                    )
+                rkey = jax.random.fold_in(
+                    jax.random.key(cfg.seed + 0x5EED), self._host_step
                 )
-            rkey = jax.random.fold_in(
-                jax.random.key(cfg.seed + 0x5EED), self._host_step
-            )
-            with self._dispatch_lock, pipeline.sharded_program_guard():
-                self.state, n_resampled = self._resample_fn(
-                    self.state, batch, scale, rkey
-                )
-                pipeline.finish_on_cpu((self.state, n_resampled))
-        # the step program runs under the process-wide guard: on XLA:CPU
-        # its collectives must not execute concurrently with another
-        # sharded program (a second trainer's step, a producer thread's
-        # harvest) — see pipeline.sharded_program_guard
-        if self._obs is not None:
-            with self._dispatch_lock, pipeline.sharded_program_guard(), \
-                    self._obs.tracer.span("step", step=self._host_step):
-                self.state, metrics = fn(self.state, batch, scale)
-                pipeline.finish_on_cpu((self.state, metrics))
-        else:
-            with self._dispatch_lock, pipeline.sharded_program_guard():
-                self.state, metrics = fn(self.state, batch, scale)
-                pipeline.finish_on_cpu((self.state, metrics))
+                with self._dispatch_lock, pipeline.sharded_program_guard():
+                    self.state, n_resampled = self._resample_fn(
+                        self.state, batch, scale, rkey
+                    )
+                    pipeline.finish_on_cpu((self.state, n_resampled))
+            # the step program runs under the process-wide guard: on XLA:CPU
+            # its collectives must not execute concurrently with another
+            # sharded program (a second trainer's step, a producer thread's
+            # harvest) — see pipeline.sharded_program_guard
+            if self._obs is not None:
+                with self._dispatch_lock, pipeline.sharded_program_guard(), \
+                        self._obs.tracer.span("step", step=self._host_step):
+                    self.state, metrics = fn(self.state, batch, scale)
+                    pipeline.finish_on_cpu((self.state, metrics))
+            else:
+                with self._dispatch_lock, pipeline.sharded_program_guard():
+                    self.state, metrics = fn(self.state, batch, scale)
+                    pipeline.finish_on_cpu((self.state, metrics))
         if n_resampled is not None:
             metrics["resampled"] = n_resampled
         self._host_step += 1
@@ -967,7 +1027,12 @@ class Trainer:
             from jax.experimental import multihost_utils
 
             flag = _np.array([1 if stop_requested else 0], _np.int32)
-            return bool(multihost_utils.process_allgather(flag).max())
+            # the allgather is a program launch too: on a ticketed run it
+            # must hold a launch slot or it races the prefetch worker's
+            # collectives. Poll steps are the same ``i`` on every process,
+            # so the reservation order stays SPMD-consistent.
+            with self._launch_turn(self._reserve_ticket()):
+                return bool(multihost_utils.process_allgather(flag).max())
 
         in_main_thread = threading.current_thread() is threading.main_thread()
         if in_main_thread:

@@ -66,6 +66,27 @@ def enable(cache_dir: str | None = None) -> str | None:
     return cache_dir
 
 
+_AOT_CACHE: dict[tuple, Any] = {}
+
+
+def aot_get(key: tuple, build: Any) -> Any:
+    """Process-wide memo of AOT-compiled executables.
+
+    ``build()`` must return ``jit_fn.lower(*args).compile()`` for the
+    variant ``key`` describes (shapes/dtypes/shardings/statics — the
+    caller owns key completeness). Dispatching through the returned
+    executable skips the jit call path's tracing/cache machinery — the
+    host-cost half of the refill engine's batched dispatch
+    (docs/SCALING.md "Zero-bubble refill") — and keeps the donation and
+    shardings of the jit it was lowered from: the compiled program is
+    byte-identical to what the implicit jit call would have run.
+    """
+    got = _AOT_CACHE.get(key)
+    if got is None:
+        got = _AOT_CACHE[key] = build()
+    return got
+
+
 def contracts_check(key: str, lowered: Any) -> None:
     """``CROSSCODER_CONTRACTS`` runtime hook: re-run the textual HLO
     contracts (no-f64, no-host-transfer; ``hlo_rules.check_compiled_text``)

@@ -66,3 +66,143 @@ def drive(produced: Iterable[T], drain: Callable[[T], None], depth: int = DEFAUL
             drain(inflight.pop(0))
     for item in inflight:
         drain(item)
+
+
+class LaunchSequencer:
+    """Ticketed program-launch ordering across threads.
+
+    SPMD multi-process meshes require every process to ENQUEUE the same
+    collective programs in the same order — two threads racing their
+    dispatches resolve differently per host and deadlock the cross-host
+    rendezvous (the reason the trainer historically disabled prefetch on
+    pods). The fix: every launch site calls :meth:`reserve` on the MAIN
+    thread, in program order — identical on every process by SPMD
+    construction — and executes its launches under :meth:`turn`, which
+    blocks until all earlier tickets have released. Reservation order is
+    thereby the pod-wide launch order, regardless of which thread runs
+    each launch or when the OS schedules it.
+
+    Single-process runs don't need one (any interleaving is correct
+    there); the trainer only builds a sequencer when
+    ``multihost.needs_launch_tickets()`` says the mesh spans processes.
+    """
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._next = 0       # next ticket to hand out
+        self._head = 0       # lowest ticket not yet released
+        self._released: set[int] = set()
+
+    def reserve(self) -> int:
+        """Claim the next launch slot (call on the deciding thread, in
+        program order)."""
+        with self._cond:
+            ticket = self._next
+            self._next += 1
+            return ticket
+
+    @contextlib.contextmanager
+    def turn(self, ticket: int):
+        """Run a launch under its reserved slot: entry blocks until every
+        earlier ticket has released; exit releases this one (also on
+        exceptions, so a failed launch never wedges the sequence)."""
+        with self._cond:
+            while self._head != ticket:
+                self._cond.wait()
+        try:
+            yield
+        finally:
+            self.skip(ticket)
+
+    def skip(self, ticket: int) -> None:
+        """Release a ticket without running anything under it (a launch
+        site that reserved but then bailed — e.g. a failed submit)."""
+        with self._cond:
+            self._released.add(ticket)
+            while self._head in self._released:
+                self._released.remove(self._head)
+                self._head += 1
+            self._cond.notify_all()
+
+
+class QuantumDispatcher:
+    """Dedicated dispatcher thread for refill harvest quanta.
+
+    The refill engine's host cost is per-dispatch (~6-8 ms through a
+    tunneled client); running those dispatches on the train loop's thread
+    puts that cost inside the step cadence even when the device work
+    overlaps perfectly. This offloads them: the serve path posts CREDIT
+    (how many quanta the pacing schedule allows) via :meth:`submit` and
+    returns immediately; the daemon thread spends accumulated credit by
+    calling ``pump(credit)`` — which must take
+    :func:`sharded_program_guard` itself around any program execution.
+
+    :meth:`drain` quiesces: blocks until all posted credit is spent and
+    the pump is idle, then re-raises any exception the pump hit (refill
+    failures surface on the serve thread at the next cycle boundary, not
+    as a dead daemon). Used by the buffer at cycle completion and before
+    any state mutation that invalidates in-flight work (restore, forced
+    refresh, close).
+    """
+
+    def __init__(self, pump: Callable[[int], None], name: str = "refill-dispatch") -> None:
+        self._pump = pump
+        self._cond = threading.Condition()
+        self._credit = 0
+        self._busy = False
+        self._closed = False
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._credit == 0 and not self._closed:
+                    self._cond.wait()
+                if self._closed and self._credit == 0:
+                    return
+                credit, self._credit = self._credit, 0
+                self._busy = True
+            try:
+                if self._error is None:
+                    self._pump(credit)
+            except BaseException as e:  # noqa: BLE001 — re-raised in drain()
+                with self._cond:
+                    self._error = e
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def submit(self, credit: int) -> None:
+        """Post dispatch credit; returns immediately."""
+        if credit <= 0:
+            return
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("QuantumDispatcher is closed")
+            self._credit += credit
+            self._cond.notify_all()
+
+    def drain(self) -> None:
+        """Block until idle (all credit spent); re-raise any pump error."""
+        with self._cond:
+            while self._credit > 0 or self._busy:
+                self._cond.wait()
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+
+    def close(self) -> None:
+        """Drain, then stop the thread (idempotent; swallows pump errors —
+        close runs in teardown paths where raising would mask the primary
+        failure)."""
+        with self._cond:
+            if self._closed and not self._thread.is_alive():
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join()
+        with self._cond:
+            self._error = None

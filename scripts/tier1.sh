@@ -14,4 +14,9 @@ if command -v ruff >/dev/null 2>&1; then
 elif python -c 'import ruff' >/dev/null 2>&1; then
     python -m ruff check crosscoder_tpu scripts || exit 1
 fi
+# zero-bubble refill smoke: the overlap engine must serve a byte-identical
+# stream (fast fail here beats a confusing diff deep in the full suite)
+env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_refill_overlap.py::test_overlap_stream_identity_host \
+    -q -p no:cacheprovider || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,6 +1,10 @@
 """Unit tests for the bounded in-flight pipeline driver — the shared
 machinery under buffer refresh, norm calibration, dashboard harvest, and
-the CE eval (crosscoder_tpu/utils/pipeline.py)."""
+the CE eval — plus the zero-bubble refill engine's concurrency primitives
+(LaunchSequencer, QuantumDispatcher) in crosscoder_tpu/utils/pipeline.py."""
+
+import threading
+import time
 
 import pytest
 
@@ -67,3 +71,113 @@ def test_producer_exception_propagates():
     with pytest.raises(RuntimeError, match="boom"):
         pipeline.drive(produced(), drained.append, depth=1)
     assert drained == [1]   # FIFO items before the failure were drained
+
+
+# ---------------------------------------------------------------------------
+# LaunchSequencer — ticketed program-launch ordering (multi-process prefetch)
+
+
+def test_sequencer_executes_in_reservation_order():
+    """Threads entering their turns in REVERSE order still execute in
+    reservation order — the SPMD launch-order guarantee."""
+    seq = pipeline.LaunchSequencer()
+    tickets = [seq.reserve() for _ in range(3)]
+    order = []
+
+    def run(ticket, delay):
+        time.sleep(delay)
+        with seq.turn(ticket):
+            order.append(ticket)
+
+    threads = [
+        threading.Thread(target=run, args=(t, d))
+        for t, d in zip(tickets, (0.06, 0.03, 0.0))   # last ticket tries first
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert order == tickets
+
+
+def test_sequencer_skip_unblocks_later_turns():
+    seq = pipeline.LaunchSequencer()
+    a, b = seq.reserve(), seq.reserve()
+    seq.skip(a)                 # a reservation that bailed (failed submit)
+    ran = []
+    with seq.turn(b):
+        ran.append(b)
+    assert ran == [b]
+
+
+def test_sequencer_releases_on_exception():
+    """A launch that raises inside its turn must still release the slot —
+    a wedged head ticket would deadlock every later launch."""
+    seq = pipeline.LaunchSequencer()
+    a, b = seq.reserve(), seq.reserve()
+    with pytest.raises(RuntimeError, match="launch failed"):
+        with seq.turn(a):
+            raise RuntimeError("launch failed")
+    done = []
+    t = threading.Thread(target=lambda: seq.turn(b).__enter__() or done.append(b))
+    t.start()
+    t.join(timeout=5)
+    assert done == [b]
+
+
+def test_sequencer_out_of_order_release():
+    """Tickets released out of order (b skips before a runs) advance the
+    head past BOTH once a releases."""
+    seq = pipeline.LaunchSequencer()
+    a, b, c = seq.reserve(), seq.reserve(), seq.reserve()
+    seq.skip(b)
+    seq.skip(a)
+    with seq.turn(c):
+        pass                    # would hang if the head stuck at b
+
+
+# ---------------------------------------------------------------------------
+# QuantumDispatcher — the refill engine's offloaded dispatch thread
+
+
+def test_dispatcher_spends_all_credit():
+    got = []
+    d = pipeline.QuantumDispatcher(got.append)
+    for credit in (3, 2, 5):
+        d.submit(credit)
+    d.drain()
+    assert sum(got) == 10
+    d.close()
+
+
+def test_dispatcher_drain_reraises_pump_error():
+    """A harvest failure on the dispatcher thread surfaces on the caller's
+    thread at the next quiesce point, not as a silently dead daemon."""
+    def pump(credit):
+        raise RuntimeError("pump boom")
+
+    d = pipeline.QuantumDispatcher(pump)
+    d.submit(1)
+    with pytest.raises(RuntimeError, match="pump boom"):
+        d.drain()
+    d.drain()                   # the error was consumed, not sticky
+    d.close()
+
+
+def test_dispatcher_close_idempotent_and_rejects_submit():
+    d = pipeline.QuantumDispatcher(lambda credit: None)
+    d.submit(2)
+    d.close()
+    d.close()                   # second close is a no-op
+    with pytest.raises(RuntimeError, match="closed"):
+        d.submit(1)
+
+
+def test_dispatcher_zero_credit_is_noop():
+    calls = []
+    d = pipeline.QuantumDispatcher(calls.append)
+    d.submit(0)
+    d.submit(-3)
+    d.drain()
+    assert calls == []
+    d.close()

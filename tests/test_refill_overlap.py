@@ -1,0 +1,325 @@
+"""Zero-bubble refill engine (cfg.refill_overlap; docs/SCALING.md
+"Zero-bubble refill"):
+
+- served-batch stream byte-identical overlap-on vs overlap-off across all
+  three store placements (host RAM / single-device HBM / mesh-sharded),
+  including a mid-cycle checkpoint resume;
+- ``SegmentedHarvest.step_many`` (the batched k-wide sub-scan dispatch)
+  bitwise-equals the narrow ``step()`` loop;
+- zero-cost off: the compiled train step's HLO is byte-identical across
+  the new knobs, and overlap-on adds NO host↔device transfers;
+- the trainer's ticketed launch sequencer (multi-process prefetch) leaves
+  the single-process loss trajectory unchanged;
+- config validation of the new knobs.
+
+All CPU, tier-1; the host-store stream-identity test doubles as the
+scripts/tier1.sh smoke.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data.buffer import PairedActivationBuffer, make_buffer
+from crosscoder_tpu.models import lm
+
+SEQ = 17          # rows_per_seq = 16
+HP = "blocks.2.hook_resid_pre"
+
+
+@pytest.fixture(scope="module")
+def lm_pair():
+    cfg = lm.LMConfig.tiny()
+    pa = lm.init_params(jax.random.key(0), cfg)
+    pb = lm.init_params(jax.random.key(1), cfg)
+    return cfg, [pa, pb]
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(7)
+    return rng.integers(0, 257, size=(256, SEQ), dtype=np.int64)
+
+
+def make_cfg(**kw):
+    base = dict(
+        batch_size=32, buffer_mult=32, seq_len=SEQ, d_in=32, n_models=2,
+        model_batch_size=4, norm_calib_batches=2, hook_point=HP, seed=3,
+    )
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+def _data_mesh():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1), ("data", "model"))
+    return mesh, NamedSharding(mesh, P("data", None))
+
+
+def _assert_identical_stream(off, on, n_steps):
+    """Serve both buffers in lockstep; every batch must match exactly (the
+    overlap engine swaps indices, never bytes, so this is equality — not
+    allclose)."""
+    np.testing.assert_array_equal(
+        np.asarray(on.normalisation_factor),
+        np.asarray(off.normalisation_factor),
+    )
+    for step in range(n_steps):
+        a = np.asarray(off.next())
+        b = np.asarray(on.next())
+        np.testing.assert_array_equal(b, a, err_msg=f"step {step}")
+    off.close()
+    on.close()
+
+
+# ---------------------------------------------------------------------------
+# served-stream byte identity, all three store placements
+
+
+def test_overlap_stream_identity_host(lm_pair, tokens):
+    """Host-RAM store, overlap on vs off: 40 serves cross two steady-state
+    shadow cycles; the stream must be byte-identical (also the tier-1
+    smoke — scripts/tier1.sh runs this test before the full suite)."""
+    lm_cfg, params = lm_pair
+    off = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    on = PairedActivationBuffer(
+        make_cfg(refill_overlap="on"), lm_cfg, params, tokens
+    )
+    # the engine actually engaged: spare region = one steady-state refill
+    # (32 seqs × 16 rows), offloaded dispatcher thread live on the host store
+    assert on._spare_rows == 512 and on._store_rows == 1024 + 512
+    assert on._dispatcher is not None
+    _assert_identical_stream(off, on, n_steps=40)
+
+
+def test_overlap_shadow_swap_rotates_row_map(lm_pair, tokens):
+    """After a steady-state cycle completes, the swapped logical rows point
+    at the previous spare region — index bookkeeping really happened (a
+    row_map stuck at identity would mean the shadow path silently fell
+    back to in-place writes)."""
+    lm_cfg, params = lm_pair
+    b = PairedActivationBuffer(
+        make_cfg(refill_overlap="on"), lm_cfg, params, tokens
+    )
+    assert np.array_equal(b._row_map, np.arange(b.buffer_size))  # full fill in-place
+    for _ in range(16):            # through the first steady-state cycle
+        b.next()
+    assert not np.array_equal(b._row_map, np.arange(b.buffer_size))
+    # row map stays a bijection onto the physical store
+    occupied = np.concatenate([b._row_map, b._free_rows])
+    assert np.array_equal(np.sort(occupied), np.arange(b._store_rows))
+    b.close()
+
+
+def test_overlap_stream_identity_hbm(lm_pair, tokens):
+    """Single-device HBM store (donated-scatter placement — pumps inline,
+    no dispatcher thread): stream byte-identical overlap on vs off."""
+    lm_cfg, params = lm_pair
+    off = make_buffer(make_cfg(buffer_device="hbm"), lm_cfg, params, tokens)
+    on = make_buffer(
+        make_cfg(buffer_device="hbm", refill_overlap="on"), lm_cfg, params,
+        tokens,
+    )
+    assert on._dispatcher is None          # _DISPATCH_THREAD_OK = False
+    _assert_identical_stream(off, on, n_steps=40)
+
+
+def test_overlap_stream_identity_mesh(lm_pair, tokens):
+    """Mesh-sharded HBM store over the 8-way data axis: stream
+    byte-identical overlap on vs off, batches still in the step's batch
+    sharding."""
+    from crosscoder_tpu.data.buffer import MeshPairedActivationBuffer
+
+    lm_cfg, params = lm_pair
+    _, sh = _data_mesh()
+    off = make_buffer(make_cfg(buffer_device="hbm"), lm_cfg, params, tokens,
+                      batch_sharding=sh)
+    on = make_buffer(
+        make_cfg(buffer_device="hbm", refill_overlap="on"), lm_cfg, params,
+        tokens, batch_sharding=sh,
+    )
+    assert isinstance(on, MeshPairedActivationBuffer)
+    assert on._dispatcher is None
+    _assert_identical_stream(off, on, n_steps=40)
+
+
+def test_overlap_mid_cycle_resume_matches_off(lm_pair, tokens):
+    """state_dict taken MID shadow cycle equals the overlap-off snapshot
+    (deferred provenance: an unfinished shadow cycle must not have touched
+    _src_global), and both buffers restored from it serve identical
+    streams across the next two cycles."""
+    lm_cfg, params = lm_pair
+    off = PairedActivationBuffer(make_cfg(), lm_cfg, params, tokens)
+    on = PairedActivationBuffer(
+        make_cfg(refill_overlap="on"), lm_cfg, params, tokens
+    )
+    for _ in range(5):                 # mid-cycle: trigger is at serve 16
+        off.next(), on.next()
+    on._quiesce_dispatch()
+    state = off.state_dict()
+    assert on.state_dict() == state
+    off.load_state_dict(state)
+    on.load_state_dict(state)
+    _assert_identical_stream(off, on, n_steps=36)
+
+
+# ---------------------------------------------------------------------------
+# batched dispatch: step_many == step loop, bitwise
+
+
+def test_step_many_bitwise_equals_step(lm_pair, tokens):
+    lm_cfg, params = lm_pair
+    tok = jax.numpy.asarray(tokens[:4])
+
+    def run(advance):
+        job = lm.SegmentedHarvest(params, tok, lm_cfg, [HP],
+                                  out_dtype=jax.numpy.bfloat16)
+        advance(job)
+        return job
+
+    narrow = run(lambda j: [None for _ in iter(j.step, False)])
+    # one giant batched call: consumes exactly the step() budget
+    wide = lm.SegmentedHarvest(params, tok, lm_cfg, [HP],
+                               out_dtype=jax.numpy.bfloat16)
+    used, alive = wide.step_many(1 << 30)
+    assert (used, alive) == (wide.n_steps, False)
+    # and a mid-size batch that straddles the model boundary
+    chunked = run(lambda j: [None for _ in iter(
+        lambda: j.step_many(3)[1], False)])
+    want = np.asarray(narrow.result(), np.float32)
+    np.testing.assert_array_equal(np.asarray(wide.result(), np.float32), want)
+    np.testing.assert_array_equal(np.asarray(chunked.result(), np.float32),
+                                  want)
+
+
+def test_step_many_quantum_accounting(lm_pair, tokens):
+    """step_many's consumed-quanta accounting matches step(): the pacing
+    schedule (credits per serve) must mean the same thing on both paths."""
+    lm_cfg, params = lm_pair
+    tok = jax.numpy.asarray(tokens[:4])
+    job = lm.SegmentedHarvest(params, tok, lm_cfg, [HP])
+    total, alive = 0, True
+    while alive:
+        used, alive = job.step_many(2)
+        assert used >= 1
+        total += used
+    assert total == job.n_steps
+
+
+# ---------------------------------------------------------------------------
+# zero-cost off
+
+
+def test_step_hlo_independent_of_refill_overlap():
+    """refill_overlap / refill_dispatch_batch are host-side data-plane
+    knobs: the compiled train step must be byte-identical across them
+    (the contracts engine pins the same invariant repo-wide via
+    hlo-refill-overlap-off-identity)."""
+    from crosscoder_tpu.analysis.contracts.hlo_rules import lower_step_text
+
+    base = dict(d_in=16, dict_size=64, batch_size=32, enc_dtype="fp32",
+                l1_coeff=0.02)
+    off = lower_step_text(CrossCoderConfig(**base))
+    on = lower_step_text(CrossCoderConfig(
+        **base, refill_overlap="on", refill_dispatch_batch=8))
+    assert off == on
+
+
+def test_overlap_adds_no_host_device_transfers(lm_pair, tokens, monkeypatch):
+    """The engine moves indices, not rows: construction + one full
+    steady-state cycle performs exactly the same number of
+    device_put/device_get calls with overlap on as off (host store — the
+    placement where every chunk crosses the link)."""
+    lm_cfg, params = lm_pair
+    real_put, real_get = jax.device_put, jax.device_get
+
+    def run(**kw):
+        put, get = [], []
+        monkeypatch.setattr(jax, "device_put",
+                            lambda *a, **k: (put.append(1), real_put(*a, **k))[1])
+        monkeypatch.setattr(jax, "device_get",
+                            lambda x: (get.append(1), real_get(x))[1])
+        try:
+            b = PairedActivationBuffer(make_cfg(**kw), lm_cfg, params, tokens)
+            for _ in range(16):        # exactly one steady-state cycle
+                b.next()
+            b._quiesce_dispatch()      # count offloaded drains too
+            b.close()
+        finally:
+            monkeypatch.setattr(jax, "device_put", real_put)
+            monkeypatch.setattr(jax, "device_get", real_get)
+        return len(put), len(get)
+
+    off = run()
+    on = run(refill_overlap="on")
+    assert on == off, (on, off)
+    assert off[1] > 0          # the counter saw the chunk fetches
+
+
+# ---------------------------------------------------------------------------
+# ticketed launch sequencer through the trainer
+
+
+def test_trainer_ticketed_prefetch_matches_unticketed(monkeypatch):
+    """Force needs_launch_tickets() on in a single process: the trainer
+    builds the sequencer, prefetch stays enabled, and the loss trajectory
+    is identical to the unticketed run (tickets order launches; they must
+    not change what is launched)."""
+    from crosscoder_tpu.parallel import multihost
+    from crosscoder_tpu.train.trainer import Trainer
+
+    def cfg():
+        return CrossCoderConfig(
+            d_in=16, dict_size=64, batch_size=32, num_tokens=32 * 400,
+            enc_dtype="fp32", lr=2e-3, l1_coeff=0.02, log_backend="null",
+            prefetch=True,
+        )
+
+    def losses(tr):
+        out = [float(jax.device_get(tr.step()["loss"])) for _ in range(6)]
+        tr.close()
+        return out
+
+    base = losses(Trainer(cfg()))
+    monkeypatch.setattr(multihost, "needs_launch_tickets", lambda: True)
+    tr = Trainer(cfg())
+    assert tr._sequencer is not None
+    assert tr._prefetch_pool is not None     # prefetch no longer disabled
+    assert losses(tr) == base
+
+
+def test_trainer_sequencer_checkpoint_cycle(tmp_path, monkeypatch):
+    """Ticketed runs never cancel the speculative prefetch (cancellation
+    is thread-timing dependent — per-process divergence on a real pod);
+    save/restore must still work with a production in flight."""
+    from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+    from crosscoder_tpu.parallel import multihost
+    from crosscoder_tpu.train.trainer import Trainer
+
+    monkeypatch.setattr(multihost, "needs_launch_tickets", lambda: True)
+    cfg = CrossCoderConfig(
+        d_in=16, dict_size=64, batch_size=32, num_tokens=32 * 400,
+        enc_dtype="fp32", l1_coeff=0.02, log_backend="null", prefetch=True,
+        checkpoint_dir=str(tmp_path),
+    )
+    tr = Trainer(cfg, checkpointer=Checkpointer(cfg=cfg))
+    tr.step()
+    tr.save()
+    tr.restore()
+    tr.step()
+    tr.close()
+
+
+# ---------------------------------------------------------------------------
+# config validation
+
+
+def test_refill_overlap_config_validation():
+    with pytest.raises(ValueError, match="refill_overlap"):
+        make_cfg(refill_overlap="maybe")
+    with pytest.raises(ValueError, match="refill_dispatch_batch"):
+        make_cfg(refill_dispatch_batch=0)
+    make_cfg(refill_overlap="on", refill_dispatch_batch=1)   # valid corner
