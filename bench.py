@@ -1,8 +1,12 @@
 """Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Eight sections (env ``BENCH_SECTIONS``, default all; progress on stderr,
-exactly ONE machine-parseable JSON line on stdout, guaranteed last —
-stray prints are rerouted to stderr for the whole run):
+Ten sections (env ``BENCH_SECTIONS``, default all; progress on stderr).
+Output contract: stdout carries exactly ONE machine-parseable JSON line,
+guaranteed last and guaranteed **compact** (≤2 KB: headline, per-section
+key numbers, gate booleans) — the driver truncates the line at 2000
+chars, so the full per-section detail goes to an artifact file instead
+($BENCH_ARTIFACT, default BENCH_DETAIL.json). Stray prints are rerouted
+to stderr for the whole run:
 
 - **step**: the bare train step on device-resident batches (round-1's
   headline; BASELINE.json config 1 — dict 2^15, batch 4096, bf16).
@@ -23,6 +27,10 @@ stray prints are rerouted to stderr for the whole run):
   the full model — weights are random because this environment is
   air-gapped, which changes no matmul shapes). Reports steady-state
   acts/sec and the refresh-bubble profile (max vs median step).
+- **refill_overlap**: zero-bubble refill engine A/B (docs/SCALING.md
+  "Zero-bubble refill") — the e2e leg with ``refill_overlap`` off vs on
+  at fine/coarse harvest segmentation; gates on bubble_frac ≤ 0.10 with
+  no throughput loss.
 - **harvest**: the LM-harvest side (the dominant per-step cost outside
   the crosscoder) on a mixed-length synthetic corpus: padded-vs-paged
   runtime A/B — tokens/s over REAL tokens, padding-efficiency %, and the
@@ -37,6 +45,12 @@ stray prints are rerouted to stderr for the whole run):
   standard training leg emits.
 - **dash**: dashboard generation at the reference's recorded workload
   (128 seqs × 3 features, minibatch 4 — BASELINE.md: ≈19 s on A100).
+- **elastic**: the recovery SLO of elastic membership
+  (docs/resilience.md "Elastic membership") — the 2-process CPU
+  preemption drill (``resilience/elastic_drill.py``): chaos ``die@7``
+  kills one host mid-run, the survivor re-meshes and
+  restore-with-respecs; reports ``remesh_ms`` (detect → resumed wall
+  time) and the bitwise-equal recovery gate.
 
 Headline metric = e2e acts/sec/chip. ``vs_baseline`` divides by an
 analytic single-A100 torch estimate, documented here so it stays fixed:
@@ -50,7 +64,8 @@ per-chip parity — BASELINE.json.)
 
 Env knobs (debug/CI only): BENCH_SECTIONS, BENCH_DICT, BENCH_BATCH,
 BENCH_STEPS, BENCH_CPU=1, BENCH_MASTER_DTYPE, BENCH_QUANT=1 (e2e with
-the int8 replay store), QUANT_RELMSE_BOUND.
+the int8 replay store), QUANT_RELMSE_BOUND, BENCH_ARTIFACT (detail
+file path).
 """
 
 from __future__ import annotations
@@ -1143,19 +1158,120 @@ def section_dash() -> dict:
     return out
 
 
+def section_elastic() -> dict:
+    """Recovery SLO of elastic membership (docs/resilience.md "Elastic
+    membership"): the 2-process preemption drill — chaos ``die@7`` kills
+    one host mid-run; the survivor must detect, re-mesh over its local
+    devices, restore-with-respec, and finish with a post-remesh loss
+    trajectory bitwise equal to a clean restart. The drill always runs
+    CPU subprocesses with their own virtual-device worlds, so this leg
+    behaves identically on a TPU box."""
+    from crosscoder_tpu.resilience.elastic_drill import run_drill
+
+    report = run_drill()
+    out = {
+        "remesh_ms": report["remesh_ms"],
+        "bitwise_equal": bool(report["bitwise_equal"]),
+        "resume_step": report["resume_step"],
+        "post_steps": len(report["post_losses"]),
+        "workload": "2-proc CPU drill: die@7 → detect → remesh → "
+                    "respec-restore → bitwise-equal finish",
+    }
+    log(f"[elastic] {out}")
+    return out
+
+
+# stdout-summary projection: per section, the fields worth the 2 KB line
+_SUMMARY_KEYS = {
+    "step": ("acts_per_sec_chip", "vs_a100_step"),
+    "e2e": ("acts_per_sec_chip", "vs_a100_e2e", "step_ms_median",
+            "refresh_bubble_ms", "loss_finite"),
+    "refill_overlap": ("gate_ok", "seg3_gate_ok", "seg14_gate_ok"),
+    "harvest": ("padding_efficiency", "paged_step_ms", "paged_speedup"),
+    "quant": ("roundtrip_rel_mse", "quality_gate_ok"),
+    "obs": ("obs_overhead_frac", "overhead_gate_ok"),
+    "dash": ("steady_s", "vs_reference"),
+    "elastic": ("remesh_ms", "bitwise_equal"),
+}
+_GATES = (("refill_overlap", "gate_ok"), ("quant", "quality_gate_ok"),
+          ("obs", "overhead_gate_ok"), ("e2e", "loss_finite"),
+          ("elastic", "bitwise_equal"))
+
+
+def _compact(headline: dict, results: dict) -> dict:
+    """The ≤2 KB stdout summary: headline + per-section key numbers +
+    gate booleans + per-dict step-time ratios vs relu. Everything else
+    lives in the detail artifact."""
+    out = dict(headline)
+    out["gates"] = {f"{name}.{key}": bool(sec[key])
+                    for name, key in _GATES
+                    if isinstance(sec := results.get(name), dict)
+                    and key in sec}
+    for name, keys in _SUMMARY_KEYS.items():
+        sec = results.get(name)
+        if not isinstance(sec, dict):
+            continue
+        if "error" in sec:
+            out[name] = {"error": sec["error"][:120]}
+        else:
+            out[name] = {k: sec[k] for k in keys if k in sec}
+    matrix = results.get("matrix")
+    if isinstance(matrix, list):
+        relu = {e.get("dict_size"): e.get("acts_per_sec_chip")
+                for e in matrix if e.get("variant") == "relu"}
+        out["relu_acts_per_dict"] = relu
+        ratios = {}
+        for e in matrix:
+            if e.get("variant") == "relu":
+                continue
+            key = f"{e.get('variant', '?')}@{e.get('dict_size', '?')}"
+            acts = e.get("acts_per_sec_chip")
+            base = relu.get(e.get("dict_size"))
+            if acts and base:
+                ratios[key] = round(base / acts, 3)   # >1 = slower than relu
+            else:
+                ratios[key] = "skip" if "skipped" in e else "err"
+        out["step_ratio_vs_relu"] = ratios
+    configs = results.get("configs")
+    if isinstance(configs, list):
+        out["configs"] = {e.get("config", "?"):
+                          e.get("acts_per_sec_chip",
+                                "skip" if "skipped" in e else "err")
+                          for e in configs}
+    # the driver truncates the line at 2000 chars — drop the widest
+    # tables first rather than ship an unparseable line
+    for drop in ("step_ratio_vs_relu", "configs", "relu_acts_per_dict"):
+        if len(json.dumps(out)) <= 1900:
+            break
+        out.pop(drop, None)
+    return out
+
+
 def main() -> None:
     # Output contract: stdout carries EXACTLY ONE machine-parseable JSON
-    # line, emitted last (the harness records "parsed": null otherwise).
+    # line, emitted last AND compact — the driver truncates it at 2000
+    # chars (BENCH_r05 shipped "parsed": null because the full-detail
+    # line was ~8 KB). Full per-section detail goes to the artifact file.
     # Library/trainer progress prints go through plain print() → reroute
     # the whole module-level stdout to stderr for the run and write the
-    # headline to the real stream at the very end.
+    # summary to the real stream at the very end.
     real_stdout = sys.stdout
     sys.stdout = sys.stderr
     try:
-        headline = _run_sections()
+        headline, results = _run_sections()
+        artifact = os.environ.get("BENCH_ARTIFACT", "BENCH_DETAIL.json")
+        detail = dict(headline)
+        detail.update(results)
+        with open(artifact, "w") as f:
+            json.dump(detail, f, indent=1, default=str)
+        summary = _compact(headline, results)
+        summary["detail"] = artifact
     finally:
         sys.stdout = real_stdout
-    print(json.dumps(headline), flush=True)
+    line = json.dumps(summary)
+    assert len(line) <= 2000, (
+        f"summary line is {len(line)} B; the driver caps at 2000")
+    print(line, flush=True)
 
 
 def _run_sections() -> dict:
@@ -1174,7 +1290,8 @@ def _run_sections() -> dict:
         cache_state = "cold"
     sections = os.environ.get(
         "BENCH_SECTIONS",
-        "step,matrix,configs,e2e,refill_overlap,harvest,quant,obs,dash"
+        "step,matrix,configs,e2e,refill_overlap,harvest,quant,obs,dash,"
+        "elastic"
     ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
@@ -1183,7 +1300,8 @@ def _run_sections() -> dict:
                      ("refill_overlap", section_refill_overlap),
                      ("harvest", section_harvest),
                      ("quant", section_quant), ("obs", section_obs),
-                     ("dash", section_dash)):
+                     ("dash", section_dash),
+                     ("elastic", section_elastic)):
         if name not in sections:
             continue
         try:
@@ -1211,8 +1329,7 @@ def _run_sections() -> dict:
             "vs_baseline": step.get("vs_a100_step"),
         }
     headline["compile_cache"] = cache_state
-    headline.update(results)
-    return headline
+    return headline, results
 
 
 if __name__ == "__main__":

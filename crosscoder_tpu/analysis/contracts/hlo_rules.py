@@ -207,10 +207,13 @@ KNOB_OFF_LATTICE: tuple[tuple[str, dict[str, Any]], ...] = (
                         keep_saves=2)),
     ("logging", dict(log_backend="jsonl", profile_dir="/tmp/prof")),
     ("refill_overlap", dict(refill_overlap="on", refill_dispatch_batch=8)),
+    ("elastic", dict(elastic="on", elastic_heartbeat_s=2.0,
+                     elastic_grace_s=9.0)),
     ("all_knobs", dict(quant_buffer=True, quant_block=8, obs="on",
                        harvest_runtime="paged", page_size=16, seq_len=1024,
                        guard_loss=True, log_backend="jsonl",
-                       refill_overlap="on", refill_dispatch_batch=8)),
+                       refill_overlap="on", refill_dispatch_batch=8,
+                       elastic="on")),
 )
 
 # the sparse/fused tiers: "off" vs a dead "auto" (no kernel live) must be
@@ -300,6 +303,27 @@ def _check_refill_overlap_off(ctx: StepContext) -> list[Finding]:
             rule="hlo-refill-overlap-off-identity", location=f"{a} vs {b}",
             message="refill_overlap/refill_dispatch_batch changed the "
                     "compiled step program — the overlap engine must be "
+                    "invisible to the step lowering",
+        ))
+    return out
+
+
+def _check_elastic_off(ctx: StepContext) -> list[Finding]:
+    """Elastic membership is pure control plane: with ``cfg.elastic="on"``
+    (plus its heartbeat/grace knobs) the TRAIN STEP must lower
+    byte-identically to the bare baseline — liveness probes and the
+    re-mesh path live entirely outside the compiled program
+    (docs/resilience.md "Elastic membership"). Split out from the generic
+    knob-off rule so the elastic contract has its own mutation self-test
+    and its own name in the report."""
+    out = []
+    for a, b, knob in ctx.identity_pairs:
+        if knob != "elastic" or ctx.texts[a] == ctx.texts[b]:
+            continue
+        out.append(Finding(
+            rule="hlo-elastic-off-identity", location=f"{a} vs {b}",
+            message="elastic/elastic_heartbeat_s/elastic_grace_s changed "
+                    "the compiled step program — membership must be "
                     "invisible to the step lowering",
         ))
     return out
@@ -416,6 +440,9 @@ HLO_RULES: list[Rule] = [
     Rule("hlo-refill-overlap-off-identity",
          "the refill overlap engine never changes the step lowering",
          _is_step_ctx, _check_refill_overlap_off),
+    Rule("hlo-elastic-off-identity",
+         "elastic membership never changes the step lowering",
+         _is_step_ctx, _check_elastic_off),
 ]
 
 

@@ -67,6 +67,15 @@ def _mut_refill_overlap() -> StepContext:
     return ctx
 
 
+def _mut_elastic() -> StepContext:
+    ctx = _step_ctx()
+    ctx.texts["off:elastic"] = _CLEAN_HLO + "// an extra lowered op\n"
+    ctx.meta["off:elastic"] = VariantMeta(n_donated_leaves=1)
+    ctx.jaxpr_consts["off:elastic"] = []
+    ctx.identity_pairs = [("base", "off:elastic", "elastic")]
+    return ctx
+
+
 def _mut_s8() -> StepContext:
     ctx = _step_ctx()
     ctx.texts["base"] += "  %q = stablehlo.convert : tensor<32x8xi8>\n"
@@ -204,6 +213,7 @@ def _mut_unused_import() -> SourceContext:
 MUTATIONS: dict[str, Callable[[], Any]] = {
     "hlo-knob-off-identity": _mut_identity,
     "hlo-refill-overlap-off-identity": _mut_refill_overlap,
+    "hlo-elastic-off-identity": _mut_elastic,
     "hlo-no-s8-when-quant-off": _mut_s8,
     "hlo-no-f64": _mut_f64,
     "hlo-donation-honored": _mut_donation,

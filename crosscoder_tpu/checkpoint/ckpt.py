@@ -507,7 +507,8 @@ class Checkpointer:
         return params, cfg
 
     def restore(
-        self, cfg: CrossCoderConfig, tx: Any, version_dir: str | Path | None = None, save: int | None = None
+        self, cfg: CrossCoderConfig, tx: Any, version_dir: str | Path | None = None, save: int | None = None,
+        n_data: int | None = None,
     ) -> tuple[Any, dict]:
         """Rebuild the full TrainState (+ pipeline meta) for resume.
 
@@ -519,13 +520,24 @@ class Checkpointer:
         chosen save is agreed across hosts (allgather-min, so a host
         whose local filesystem view is ahead rolls back with the rest);
         an explicitly requested ``save`` is the caller's agreement and is
-        verified but not negotiated — corruption there raises."""
+        verified but not negotiated — corruption there raises.
+
+        RESTORE-WITH-RESPEC: ``n_data`` is the data-axis width of the mesh
+        the state is being restored ONTO (default: cfg-derived). A
+        checkpoint written under a different mesh restores fine — the
+        TrainState is layout-free on disk and the caller re-derives
+        shardings — except the quant_grads error-feedback residuals, whose
+        SHAPE is a mesh property; those reset to zero when the layouts
+        disagree (see ``_restore_impl``). This is the elastic re-mesh
+        path's restore (docs/resilience.md) and also covers deliberate
+        topology changes between runs (e.g. TP-only → DP×TP)."""
         with trace.span("restore"):
-            return self._restore_impl(cfg, tx, version_dir, save)
+            return self._restore_impl(cfg, tx, version_dir, save, n_data)
 
     def _restore_impl(
         self, cfg: CrossCoderConfig, tx: Any,
         version_dir: str | Path | None, save: int | None,
+        n_data: int | None = None,
     ) -> tuple[Any, dict]:
         from crosscoder_tpu.train.state import init_train_state
 
@@ -579,19 +591,44 @@ class Checkpointer:
                     f"checkpoint save {v} under {vdir} failed checksum "
                     "verification (corrupt or truncated artifact)"
                 )
-        template = init_train_state(jax.random.key(cfg.seed), cfg, tx)
+        template = init_train_state(jax.random.key(cfg.seed), cfg, tx,
+                                    n_data=n_data)
         pathed, treedef = jax.tree_util.tree_flatten_with_path(template)
         with np.load(vdir / f"{v}_train_state.npz") as z:
-            if len(z.files) != len(pathed):
+            positional = all(k.startswith("leaf_") for k in z.files)
+            # Respec across mesh layouts: the quant_grads error-feedback
+            # residuals are the ONE state piece whose SHAPE is a mesh
+            # property ([n_data, ...]; absent entirely when n_data == 1), so
+            # a checkpoint from a different mesh may carry extra, missing,
+            # or differently-shaped quant_ef leaves. Those RESET to the
+            # template's zero init — error feedback is a compression
+            # residual, and resetting costs one step of re-accumulated
+            # quantization error, not correctness. Every other leaf stays
+            # strict. Positional (leaf_i) layouts predate path keys and
+            # cannot identify quant_ef leaves, so they keep the strict
+            # contract.
+            def _is_ef(key: str) -> bool:
+                return not positional and "quant_ef" in key
+
+            tkeys = [
+                f"leaf_{i}" if positional else jax.tree_util.keystr(path)
+                for i, (path, _) in enumerate(pathed)
+            ]
+            if (sum(1 for k in tkeys if not _is_ef(k))
+                    != sum(1 for k in z.files if not _is_ef(k))):
                 raise ValueError(
                     f"checkpoint has {len(z.files)} leaves but state expects {len(pathed)}; "
                     "optimizer chain or model shape changed since save"
                 )
-            positional = all(k.startswith("leaf_") for k in z.files)
+            dropped = [k for k in z.files if _is_ef(k) and k not in tkeys]
+            respec_resets = list(dropped)
             loaded = []
-            for i, (path, leaf) in enumerate(pathed):
-                key = f"leaf_{i}" if positional else jax.tree_util.keystr(path)
+            for key, (path, leaf) in zip(tkeys, pathed):
                 if key not in z.files:
+                    if _is_ef(key):
+                        respec_resets.append(key)
+                        loaded.append(leaf)
+                        continue
                     raise ValueError(
                         f"checkpoint is missing state leaf {key!r}; optimizer "
                         "chain changed since save (leaves are path-keyed)"
@@ -605,6 +642,10 @@ class Checkpointer:
                 if (raw.dtype.kind == "V" and raw.dtype != want
                         and raw.dtype.itemsize == want.itemsize):
                     raw = raw.view(want)
+                if _is_ef(key) and raw.shape != leaf.shape:
+                    respec_resets.append(key)
+                    loaded.append(leaf)
+                    continue
                 arr = jax.numpy.asarray(raw, dtype=leaf.dtype)
                 # force an XLA-OWNED buffer: on the CPU backend
                 # jnp.asarray can ZERO-COPY the numpy buffer, and a state
@@ -615,6 +656,11 @@ class Checkpointer:
                 # surface it). The added zero runs an actual program, so
                 # the result lives in memory XLA allocated and may free.
                 loaded.append(arr + jax.numpy.zeros((), arr.dtype))
+            if respec_resets:
+                print(f"[crosscoder_tpu] restore-with-respec: reset "
+                      f"{len(respec_resets)} quant_ef leaf(s) to zero init "
+                      f"(checkpoint mesh layout differs from target)",
+                      flush=True, file=sys.stderr)
         for (path, b), a in zip(pathed, loaded):
             if a.shape != b.shape:
                 raise ValueError(

@@ -355,6 +355,26 @@ class CrossCoderConfig:
                                     # watchdog.py). 0 = off (default).
     harvest_retries: int = 3        # watchdog retry/extension budget
     harvest_backoff_s: float = 0.5  # base of the exponential retry backoff
+    elastic: str = "off"            # off | on: elastic multihost membership
+                                    # (resilience/elastic.py). "on" adds a
+                                    # bounded liveness barrier at the
+                                    # stop_poll_every cadence; when a peer
+                                    # host dies mid-run the surviving
+                                    # coordinator quiesces in-flight work,
+                                    # re-meshes over its local devices
+                                    # (mesh epoch +1), and resumes from the
+                                    # newest verified save via restore-
+                                    # with-respec. ZERO-COST off: the
+                                    # compiled step is byte-identical
+                                    # (hlo-elastic-off-identity).
+    elastic_heartbeat_s: float = 1.0  # elastic="on": coordination-service
+                                    # heartbeat interval (service + client)
+                                    # — how fast a dead host is NOTICED;
+                                    # detection fires after ~3 missed beats
+    elastic_grace_s: float = 5.0    # elastic="on": bounded wait of each
+                                    # liveness barrier — a peer slower than
+                                    # this at a poll point is declared lost
+                                    # (the slow-host SLO; >= heartbeat)
     # --- block-scaled int8 data plane (ops/quant.py; docs/SCALING.md
     # "Quantized data plane"). Both off by default and ZERO-COST off: the
     # compiled train step and the serve/refill paths are byte-identical to
@@ -684,6 +704,26 @@ class CrossCoderConfig:
                 f"harvest_retries/harvest_backoff_s must be >= 0, got "
                 f"{self.harvest_retries}/{self.harvest_backoff_s}"
             )
+        _check_choice("elastic", self.elastic, ("off", "on"))
+        if self.elastic == "on":
+            if self.elastic_heartbeat_s <= 0:
+                raise ValueError(
+                    f"elastic_heartbeat_s must be > 0, got "
+                    f"{self.elastic_heartbeat_s}"
+                )
+            if self.elastic_grace_s < self.elastic_heartbeat_s:
+                raise ValueError(
+                    f"elastic_grace_s ({self.elastic_grace_s}) must be >= "
+                    f"elastic_heartbeat_s ({self.elastic_heartbeat_s}): the "
+                    f"liveness barrier cannot declare a peer lost faster "
+                    f"than the heartbeat can notice it"
+                )
+            if self.seq_shards > 1:
+                raise ValueError(
+                    "elastic='on' cannot run with seq_shards > 1: the "
+                    "sequence-parallel harvest pins the mesh data axis to "
+                    "seq_shards, which a survivor re-mesh cannot preserve"
+                )
         if self.quant_block < 1:
             raise ValueError(
                 f"quant_block must be >= 1, got {self.quant_block}; 256 is "

@@ -45,6 +45,7 @@ SURVEY.md §5 checkpoint/resume).
 from __future__ import annotations
 
 import functools
+import sys
 from typing import Any, Sequence
 
 import jax
@@ -55,6 +56,7 @@ from crosscoder_tpu import native
 from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.models import lm
 from crosscoder_tpu.obs import trace
+from crosscoder_tpu.parallel import multihost
 from crosscoder_tpu.utils import pipeline
 
 _BF16 = np.dtype(jnp.bfloat16.dtype)
@@ -347,7 +349,7 @@ class PairedActivationBuffer:
             )
         else:
             if self.batch_sharding is not None:
-                tok = jax.device_put(tok, self.batch_sharding)
+                tok = multihost.put_global(tok, self.batch_sharding)
             stacked = lm.run_with_cache_multi(
                 self.model_params, tok, self.lm_cfg, self.hook_points
             )
@@ -531,9 +533,10 @@ class PairedActivationBuffer:
             self.chaos.on_harvest()    # injected stall/failure (tests only)
         if self._seq_mesh is not None or self._paged:
             return _SingleDispatchJob(self._harvest_dev(padded_tokens))
-        tok = jnp.asarray(padded_tokens)
         if self.batch_sharding is not None:
-            tok = jax.device_put(tok, self.batch_sharding)
+            tok = multihost.put_global(padded_tokens, self.batch_sharding)
+        else:
+            tok = jnp.asarray(padded_tokens)
         return lm.SegmentedHarvest(
             self.model_params, tok, self.lm_cfg, self.hook_points,
             out_dtype=jnp.bfloat16,
@@ -900,6 +903,82 @@ class PairedActivationBuffer:
         if not self._filled:
             self.normalisation_factor = self._estimate_norm_scaling_factors()
             self.refresh()
+
+    # ------------------------------------------------------------------
+    # elastic re-mesh support (resilience/elastic.py; docs/resilience.md)
+
+    def prepare_reshard(self) -> None:
+        """Quiesce in-flight refill work and park every device-resident
+        piece this buffer OWNS (the LM parameters) to host memory, ahead
+        of a backend teardown — the elastic shrink invalidates all live
+        device buffers. Must run BEFORE ``multihost.shrink_to_local()``;
+        :meth:`reshard` rebuilds the device side on the new mesh. The
+        store itself is NOT parked: it re-fills from the provenance
+        stream, which is the existing save/restore contract and cheaper
+        than dragging the multi-GB store through host RAM."""
+        try:
+            self._quiesce_dispatch()
+        except Exception as e:
+            # a dispatcher that died with the torn collective must not
+            # block the teardown — its work is discarded below anyway
+            print(f"[crosscoder_tpu] reshard: dispatcher drain failed "
+                  f"({type(e).__name__}: {e})"[:300], flush=True,
+                  file=sys.stderr)
+        self.close()
+        # in-flight harvest chunks hold device arrays that die with the
+        # backend; the post-reshard stream restore supersedes the cycle
+        self._cyc_inflight = []
+        self._cyc_job = None
+        self.model_params = [
+            jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a)), p)
+            for p in self.model_params
+        ]
+
+    def reshard(self, batch_sharding: Any | None, refill: bool = True) -> None:
+        """Re-derive every mesh-coupled piece of the buffer for a new
+        ``batch_sharding``: harvest chunk rounding, the store allocation
+        (sharded over the new mesh's data axis for the device stores), the
+        dispatcher thread (re-created when the new world qualifies), and
+        the LM params' device residency. By default the store then
+        re-fills from the live stream snapshot, so the served batch
+        sequence continues exactly as a fresh buffer restored from
+        :meth:`state_dict` would (determinism A2). ``refill=False`` leaves
+        the buffer empty for the caller's own ``load_state_dict`` — the
+        elastic restore path, which replays the CHECKPOINT's buffer
+        snapshot rather than the live one."""
+        if self.cfg.seq_shards > 1:
+            raise ValueError(
+                "reshard with seq_shards > 1 is unsupported (the mesh data "
+                "axis carries the sequence there, not the batch)"
+            )
+        snap = self.state_dict() if refill else None
+        self.batch_sharding = batch_sharding
+        data_axis = 1
+        if batch_sharding is not None:
+            data_axis = int(batch_sharding.mesh.shape.get("data", 1))
+        self._chunk_seqs = -(-self.cfg.model_batch_size // data_axis) * data_axis
+        self._plane_multiple = data_axis
+        # re-materialize the LM params on the current backend (host numpy
+        # after prepare_reshard; jit replicates them over the new mesh)
+        self.model_params = [
+            jax.tree_util.tree_map(jnp.asarray, p) for p in self.model_params
+        ]
+        self._cyc_inflight = []
+        self._cyc_job = None
+        self._cyc_seq_done = 0
+        self._perm = np.arange(self.buffer_size)
+        self._row_map = np.arange(self.buffer_size)
+        self._free_rows = self.buffer_size + np.arange(self._spare_rows)
+        self.pointer = 0
+        self._src_global = np.zeros(self.buffer_size, dtype=np.int64)
+        self.first = True
+        self._filled = False
+        self._alloc_store()
+        if (self._overlap and self._DISPATCH_THREAD_OK
+                and self._dispatcher is None and jax.process_count() == 1):
+            self._dispatcher = pipeline.QuantumDispatcher(self._pump_locked)
+        if refill:
+            self.load_state_dict(snap)
 
 
 def make_buffer(cfg: CrossCoderConfig, lm_cfg, model_params, tokens,

@@ -53,6 +53,14 @@ import numpy as np
 from crosscoder_tpu.config import parse_hook_point
 from crosscoder_tpu.utils.dtypes import dtype_of
 
+
+def _put_global(tree, shardings):
+    # collective-free host->mesh placement (multihost.put_global); local
+    # alias avoids repeating the deferred import at three call sites
+    from crosscoder_tpu.parallel import multihost
+
+    return multihost.put_global(tree, shardings)
+
 LMParams = dict[str, Any]
 
 
@@ -1051,9 +1059,10 @@ def run_with_cache_multi_paged(
         chunk.n_docs, chunk.seq_len, cfg.n_heads, cfg.n_kv_heads,
         cfg.head_dim, page_size,
     )
-    plane = jnp.asarray(chunk.tokens)
     if batch_sharding is not None:
-        plane = jax.device_put(plane, batch_sharding)
+        plane = _put_global(chunk.tokens, batch_sharding)
+    else:
+        plane = jnp.asarray(chunk.tokens)
     if pad_mode not in ("zero", "wrap"):
         raise ValueError(f"pad_mode must be zero|wrap, got {pad_mode!r}")
     return _paged_multi_impl(
@@ -1115,7 +1124,7 @@ def shard_params_tp(params: LMParams, mesh, axis: str = "model") -> LMParams:
     """Place (or re-place) LM params in the tensor-parallel layout. The
     returned pytree feeds every forward/harvest entry point unchanged —
     jit picks the layout up from the arrays and partitions accordingly."""
-    return jax.device_put(params, tp_shardings(mesh, axis))
+    return _put_global(params, tp_shardings(mesh, axis))
 
 
 # ---------------------------------------------------------------------------
@@ -1347,7 +1356,7 @@ def from_torch_state_dict(
         sh = shardings
         for k in path:
             sh = sh[k]
-        return jax.device_put(arr, sh)
+        return _put_global(arr, sh)
 
     def stack(key: str, fmt: str, transpose: bool) -> jax.Array:
         mats = [get(fmt.format(i)) for i in range(cfg.n_layers)]

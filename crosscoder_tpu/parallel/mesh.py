@@ -148,4 +148,6 @@ def state_shardings(mesh: Mesh, state: Any, shard_sources: bool = False) -> Any:
 
 def shard_state(mesh: Mesh, state: Any, shard_sources: bool = False) -> Any:
     """Place a host-built TrainState onto the mesh per the rules above."""
-    return jax.device_put(state, state_shardings(mesh, state, shard_sources))
+    from crosscoder_tpu.parallel import multihost
+
+    return multihost.put_global(state, state_shardings(mesh, state, shard_sources))

@@ -46,9 +46,24 @@ multi-process runs (violations deadlock cross-host rendezvous):
 
 from __future__ import annotations
 
+import dataclasses
 import os
+import sys
+import threading
 
 import jax
+
+
+def _enable_cpu_collectives() -> None:
+    """Cross-process collectives on the XLA:CPU backend need an explicit
+    collectives implementation (gloo over TCP); without it every
+    multi-device program spanning processes fails with "Multiprocess
+    computations aren't implemented on the CPU backend". TPU/GPU backends
+    bring their own fabric, so this is CPU-only and must run BEFORE the
+    backend is created (i.e. before the first jax computation)."""
+    if (getattr(jax.config, "jax_platforms", None) == "cpu"
+            or os.environ.get("JAX_PLATFORMS") == "cpu"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
 def initialize(
@@ -71,6 +86,7 @@ def initialize(
     opted_in = os.environ.get("CROSSCODER_MULTIHOST") == "1"
     if not explicit and not opted_in:
         return False
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=explicit,
         num_processes=num_processes,
@@ -102,3 +118,217 @@ def process_info() -> dict[str, int]:
         "local_devices": jax.local_device_count(),
         "global_devices": jax.device_count(),
     }
+
+
+def put_global(tree, shardings):
+    """Place host-built values onto (possibly cross-process) shardings
+    WITHOUT cross-process collectives.
+
+    ``jax.device_put(host_array, non_addressable_sharding)`` runs a
+    cross-process ``assert_equal`` broadcast per leaf to check the hosts
+    agree on the value. On the gloo CPU transport that rapid-fire sequence
+    of mixed-size all-reduces intermittently pairs mismatched ops
+    (``gloo::EnforceNotMet: op.preamble.length <= op.nbytes``) and kills
+    the run — and the check is redundant here: every caller passes values
+    that are SPMD-identical by construction (seeded init, the synthetic
+    stream, checkpoint artifacts). Each process therefore just slices its
+    addressable shards out of the (globally identical) host value via
+    ``make_array_from_callback``: zero communication, same result.
+
+    Device-resident committed arrays and fully-addressable shardings keep
+    the plain ``device_put`` path (no assert, no flakiness there).
+    """
+    import numpy as np
+
+    def _put(x, s):
+        if getattr(s, "is_fully_addressable", True):
+            return jax.device_put(x, s)
+        if isinstance(x, jax.Array) and getattr(x, "_committed", False):
+            # already on devices: XLA's resharding path, collective-safe
+            return jax.device_put(x, s)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(arr.shape, s, lambda idx: arr[idx])
+
+    return jax.tree_util.tree_map(_put, tree, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership (cfg.elastic; resilience/elastic.py drives this layer).
+#
+# ``jax.distributed.initialize`` builds a coordination-service client whose
+# default missed-heartbeat callback TERMINATES the process ("another task
+# died") — correct for gang-scheduled jobs, fatal for elastic ones: the
+# survivor must outlive its peers. ``elastic_initialize`` therefore builds
+# the service/client itself through the same runtime factories, with a
+# callback that records the loss instead, and wires the result into
+# ``jax._src.distributed.global_state`` so backend creation (and the gloo
+# CPU collectives) pick it up exactly as if jax had built it.
+#
+# Membership is versioned by a monotonically increasing MESH EPOCH: epoch 0
+# is the gang-start world; every survivor re-mesh (``shrink_to_local``)
+# increments it. Liveness-barrier keys embed the epoch, so a stale peer of
+# epoch N can never rendezvous with an epoch-N+1 barrier.
+
+
+@dataclasses.dataclass(frozen=True)
+class Membership:
+    """One epoch of the membership view."""
+
+    epoch: int
+    num_processes: int
+    process_id: int
+    coordinator_address: str | None
+
+
+class _ElasticState:
+    def __init__(self) -> None:
+        self.membership: Membership | None = None
+        self.peer_lost = threading.Event()
+
+
+_elastic = _ElasticState()
+
+
+def elastic_initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    heartbeat_s: float = 1.0,
+) -> Membership:
+    """Join an N-process world that can SURVIVE member loss.
+
+    Must run before the first jax computation (like :func:`initialize`).
+    Process 0 hosts the coordination service and is the only process that
+    can survive a re-mesh (the service dies with its host — a documented
+    limitation of the coordinator-backed liveness design; production
+    slices put the service on the most protected host).
+    """
+    from jax._src import distributed
+    from jax._src.lib import xla_extension
+
+    gs = distributed.global_state
+    if gs.client is not None:
+        raise RuntimeError("distributed runtime already initialized")
+    _enable_cpu_collectives()
+    beat = max(1, round(heartbeat_s))
+
+    def _on_missed_heartbeat(status) -> None:
+        # a peer stopped heartbeating: record it for the controller's next
+        # poll instead of the default LOG(FATAL) process termination
+        print(f"[crosscoder_tpu] elastic: peer heartbeat lost ({status})",
+              flush=True, file=sys.stderr)
+        _elastic.peer_lost.set()
+
+    port = coordinator_address.rsplit(":", 1)[1]
+    if process_id == 0:
+        gs.service = xla_extension.get_distributed_runtime_service(
+            f"[::]:{port}", num_processes,
+            heartbeat_interval=beat, max_missing_heartbeats=3,
+        )
+    gs.client = xla_extension.get_distributed_runtime_client(
+        coordinator_address, process_id, init_timeout=60,
+        heartbeat_interval=beat, max_missing_heartbeats=3,
+        missed_heartbeat_callback=_on_missed_heartbeat,
+        shutdown_on_destruction=False, use_compression=True,
+    )
+    gs.client.connect()
+    gs.process_id = process_id
+    gs.num_processes = num_processes
+    gs.coordinator_address = coordinator_address
+    _elastic.peer_lost.clear()
+    _elastic.membership = Membership(
+        epoch=0, num_processes=num_processes, process_id=process_id,
+        coordinator_address=coordinator_address,
+    )
+    return _elastic.membership
+
+
+def membership() -> Membership | None:
+    """The current membership view (None outside an elastic runtime)."""
+    return _elastic.membership
+
+
+def peer_loss_flagged() -> bool:
+    """True once the coordination heartbeat has reported a dead peer
+    (asynchronous — the flag may trail the actual death by up to
+    ~3 heartbeat intervals)."""
+    return _elastic.peer_lost.is_set()
+
+
+def probe_liveness(seq: int, timeout_s: float) -> bool:
+    """One bounded membership barrier: True when every peer of the current
+    epoch arrived within ``timeout_s``. The key embeds (epoch, seq) so the
+    probe is SPMD-consistent — every process must call it with the same
+    ``seq`` (a step index) — and cannot collide across epochs or with the
+    final-save barrier. Healthy worlds clear it in well under a
+    millisecond; a dead peer either fails it fast (the service already
+    marked the task dead) or times it out."""
+    m = _elastic.membership
+    if m is None or m.num_processes <= 1:
+        return True
+    if _elastic.peer_lost.is_set():
+        return False
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        return True
+    try:
+        client.wait_at_barrier(
+            f"crosscoder_tpu_elastic_{m.epoch}_{seq}",
+            timeout_in_ms=max(1, int(timeout_s * 1000)),
+        )
+        return True
+    except Exception as e:
+        print(f"[crosscoder_tpu] elastic: liveness barrier {m.epoch}/{seq} "
+              f"failed ({type(e).__name__}: {e})"[:400], flush=True,
+              file=sys.stderr)
+        _elastic.peer_lost.set()
+        return False
+
+
+def shrink_to_local() -> Membership:
+    """Tear the distributed runtime down to a single-process world over
+    this host's local devices, bumping the mesh epoch.
+
+    Only the coordinator host (process 0) can meaningfully shrink: the
+    coordination service lives here, and the survivor set is {self}. All
+    live device buffers are INVALIDATED by the backend reset — callers
+    must have quiesced in-flight work and must rebuild every device value
+    (the elastic controller restores from the newest verified checkpoint).
+    """
+    from jax._src import distributed
+
+    gs = distributed.global_state
+    old = _elastic.membership
+    if old is None:
+        raise RuntimeError("shrink_to_local outside an elastic runtime")
+    for obj, label in ((gs.client, "client"), (gs.service, "service")):
+        if obj is not None:
+            try:
+                obj.shutdown()
+            except Exception as e:  # peers are dead: shutdown barriers fail
+                print(f"[crosscoder_tpu] elastic: {label} shutdown "
+                      f"({type(e).__name__}: {e})"[:300], flush=True,
+                      file=sys.stderr)
+    gs.client = None
+    gs.service = None
+    gs.process_id = 0
+    gs.num_processes = 1
+    gs.coordinator_address = None
+    jax.clear_caches()
+    # the gloo CPU collectives object is bound to the dead client — the
+    # re-created single-process backend must not ask for one (a no-op
+    # off-CPU, where the flag never left its default)
+    if (getattr(jax.config, "jax_platforms", None) == "cpu"
+            or os.environ.get("JAX_PLATFORMS") == "cpu"):
+        jax.config.update("jax_cpu_collectives_implementation", "none")
+    from jax.extend import backend as jax_backend
+
+    jax_backend.clear_backends()
+    _elastic.peer_lost.clear()
+    _elastic.membership = Membership(
+        epoch=old.epoch + 1, num_processes=1, process_id=0,
+        coordinator_address=None,
+    )
+    return _elastic.membership

@@ -28,7 +28,18 @@ comma-separated spec (see :meth:`Chaos.parse`), e.g.::
 Grammar (``N`` = event index, ``SEC`` = float seconds):
 
 - ``nan@N`` / ``inf@N``     — poison the batch of serve N
-- ``stall@N[:SEC]``         — stall serve N (default 30 s)
+- ``stall@N[:SEC]``         — stall serve N (default 30 s); on a
+  multi-process mesh this doubles as the SLOW-HOST fault: a host stalled
+  past ``cfg.elastic_grace_s`` at a liveness poll is declared lost
+- ``preempt@N``             — SIGTERM to self at serve N (the preemption
+  notice: the trainer's handler coordinates a clean stop-and-save)
+- ``die@N``                 — ``os._exit`` at serve N (abrupt host loss,
+  no notification — the elastic membership path, docs/resilience.md).
+  Serve boundaries are where the host holds no collective mid-flight,
+  so the fault models a host dying between (not inside) its programs;
+  a mid-collective death additionally surfaces as a torn-collective
+  error on the survivors, which the elastic controller confirms via
+  the same membership barrier
 - ``fail@N``                — raise ChaosFault at serve N
 - ``stall-harvest@N[:SEC]`` — stall harvest chunk N
 - ``fail-harvest@N``        — raise ChaosFault at harvest chunk N
@@ -71,6 +82,8 @@ class Chaos:
         inf_serves: tuple[int, ...] = (),
         stall_serves: dict[int, float] | None = None,
         fail_serves: tuple[int, ...] = (),
+        preempt_serves: tuple[int, ...] = (),
+        die_serves: tuple[int, ...] = (),
         stall_harvests: dict[int, float] | None = None,
         fail_harvests: tuple[int, ...] = (),
         corrupt_saves: dict[int, str] | None = None,
@@ -89,6 +102,8 @@ class Chaos:
         self.inf_serves = tuple(inf_serves)
         self.stall_serves = dict(stall_serves or {})
         self.fail_serves = tuple(fail_serves)
+        self.preempt_serves = tuple(preempt_serves)
+        self.die_serves = tuple(die_serves)
         self.stall_harvests = dict(stall_harvests or {})
         self.fail_harvests = tuple(fail_harvests)
         self.corrupt_saves = dict(corrupt_saves or {})
@@ -108,7 +123,8 @@ class Chaos:
             return None
         kw: dict[str, Any] = {
             "nan_serves": [], "inf_serves": [], "stall_serves": {},
-            "fail_serves": [], "stall_harvests": {}, "fail_harvests": [],
+            "fail_serves": [], "preempt_serves": [], "die_serves": [],
+            "stall_harvests": {}, "fail_harvests": [],
             "corrupt_saves": {},
         }
         for raw in spec.split(","):
@@ -134,6 +150,10 @@ class Chaos:
                 kw["stall_serves"][idx] = float(extra) if extra else _DEFAULT_STALL_S
             elif kind == "fail":
                 kw["fail_serves"].append(idx)
+            elif kind == "preempt":
+                kw["preempt_serves"].append(idx)
+            elif kind == "die":
+                kw["die_serves"].append(idx)
             elif kind == "stall-harvest":
                 kw["stall_harvests"][idx] = float(extra) if extra else _DEFAULT_STALL_S
             elif kind == "fail-harvest":
@@ -145,6 +165,8 @@ class Chaos:
         kw["nan_serves"] = tuple(kw["nan_serves"])
         kw["inf_serves"] = tuple(kw["inf_serves"])
         kw["fail_serves"] = tuple(kw["fail_serves"])
+        kw["preempt_serves"] = tuple(kw["preempt_serves"])
+        kw["die_serves"] = tuple(kw["die_serves"])
         kw["fail_harvests"] = tuple(kw["fail_harvests"])
         return cls(**kw)
 
@@ -174,6 +196,25 @@ class Chaos:
             time.sleep(self.stall_serves[serve])
         if serve in self.fail_serves and self._fire("fail_serve", serve):
             raise ChaosFault(f"chaos: injected failure at serve {serve}")
+        if serve in self.preempt_serves and self._fire("preempt", serve):
+            # the preemption notice: SIGTERM to self — the trainer's
+            # handler turns it into a coordinated stop-and-save
+            import os
+            import signal
+
+            print(f"[crosscoder_tpu] chaos: preempting self (SIGTERM) at "
+                  f"serve {serve}", flush=True, file=sys.stderr)
+            os.kill(os.getpid(), signal.SIGTERM)
+        if serve in self.die_serves and self._fire("die", serve):
+            # abrupt host loss: no cleanup, no notification — the process
+            # vanishes mid-run exactly like a preempted/failed host whose
+            # notice never arrived (elastic membership's fault model)
+            import os
+
+            print(f"[crosscoder_tpu] chaos: dying (os._exit) at serve "
+                  f"{serve}", flush=True, file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(43)
 
     def poison_batch(self, batch: Any, serve: int) -> Any:
         """Overwrite row 0 of serve ``serve``'s batch with NaN/Inf."""

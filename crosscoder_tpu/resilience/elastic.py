@@ -1,0 +1,153 @@
+"""Elastic multihost membership: survive host loss mid-run (cfg.elastic).
+
+The resilience subsystem's recovery ladder so far handled bad DATA
+(divergence guard + rollback), bad ARTIFACTS (verified restore), and slow
+HOSTS (watchdog) — but a dead host still killed the whole gang-scheduled
+run. This controller closes that gap for the coordinator host:
+
+- **Liveness** rides the coordination service that
+  :func:`crosscoder_tpu.parallel.multihost.elastic_initialize` builds with
+  a non-fatal missed-heartbeat callback: a bounded membership barrier
+  (``probe``) at the trainer's existing ``stop_poll_every`` cadence, plus
+  the asynchronous heartbeat flag for losses between polls. A peer that
+  dies mid-collective surfaces as an exception out of the blocked program
+  (the dead host's sockets close); ``confirm_peer_loss`` disambiguates
+  that from an ordinary software error with one more bounded barrier.
+- **Membership epochs** are monotonic: every survivor re-mesh bumps the
+  epoch (:func:`multihost.shrink_to_local`), and all liveness keys embed
+  it, so a stale or half-dead peer of epoch N can never rendezvous with
+  the epoch-N+1 world.
+- **Re-meshing**: the survivor tears the distributed runtime down to a
+  single-process world over its local devices and rebuilds the standard
+  ``('data','model')`` mesh there (TP width preserved — the dictionary
+  sharding is a model-semantics choice; the data axis absorbs the loss).
+  Every live device buffer dies with the old backend, which is exactly
+  why the recovery path runs restore-with-respec from the newest VERIFIED
+  checkpoint rather than trying to salvage device state of unknown
+  consistency.
+
+Only process 0 (the coordination-service host) can survive: the service
+dies with its host. That is a deliberate scope cut, not an accident —
+symmetric survivor election needs an external membership service, and the
+TPU-fleet preemption story (PAPERS.md, arXiv:2605.25645) preempts workers
+far more often than the protected coordinator.
+
+Zero-cost off: with ``cfg.elastic="off"`` (default) no controller object
+exists, the train loop carries only is-None checks, and the compiled step
+HLO is byte-identical (contracts rule ``hlo-elastic-off-identity``).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+
+from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.parallel import multihost
+
+
+class PeerLoss(RuntimeError):
+    """Raised into the train loop when membership confirms a dead peer."""
+
+
+class ElasticController:
+    """Liveness probing + survivor re-mesh for one training run.
+
+    The trainer owns quiescing its in-flight work and re-deriving its
+    shardings/compiled steps; this controller owns the membership
+    protocol: when to probe, what a failed probe means, and how the
+    survivor world is rebuilt.
+    """
+
+    def __init__(self, cfg, counters=None) -> None:
+        self.cfg = cfg
+        self.counters = counters
+        self._confirm_seq = 0   # exception-time probes, SPMD-consistent
+                                # (every process reaches the same failure
+                                # point and has run the same count)
+
+    # -- liveness ------------------------------------------------------
+
+    def active(self) -> bool:
+        m = multihost.membership()
+        return m is not None and m.num_processes > 1
+
+    def epoch(self) -> int:
+        m = multihost.membership()
+        return 0 if m is None else m.epoch
+
+    def should_probe(self, step: int) -> bool:
+        """Probe at the trainer's stop-poll cadence — the same steps on
+        every process, so the barrier keys are SPMD-consistent."""
+        return self.active() and step % int(self.cfg.stop_poll_every) == 0
+
+    def probe(self, step: int) -> bool:
+        """True when all peers are alive; False declares peer loss."""
+        if self.counters is not None:
+            self.counters.bump("elastic_probes")
+        return multihost.probe_liveness(
+            f"p{step}", timeout_s=self.cfg.elastic_grace_s
+        )
+
+    def confirm_peer_loss(self, exc: BaseException) -> bool:
+        """An exception escaped the step/serve path: was it a dying peer
+        (collective torn mid-flight) or an ordinary bug? The heartbeat
+        flag answers immediately when set; otherwise one bounded barrier
+        does — every healthy process hit the same SPMD failure point and
+        runs the same confirmation, so a software error confirms healthy
+        on all of them and re-raises everywhere."""
+        if not self.active():
+            return False
+        if multihost.peer_loss_flagged():
+            return True
+        self._confirm_seq += 1
+        print(f"[crosscoder_tpu] elastic: confirming membership after "
+              f"{type(exc).__name__}", flush=True, file=sys.stderr)
+        return not multihost.probe_liveness(
+            f"x{self._confirm_seq}", timeout_s=self.cfg.elastic_grace_s
+        )
+
+    # -- survivor re-mesh ----------------------------------------------
+
+    def shrink(self):
+        """Re-mesh over the survivor set (this host's local devices).
+
+        Returns the new mesh. Callers must treat every pre-existing
+        device value as dead and rebuild from host/disk state.
+        """
+        m = multihost.membership()
+        if m is None:
+            raise PeerLoss("peer lost but no elastic membership to shrink")
+        if m.process_id != 0:
+            # the coordination service died with (or belongs to) another
+            # host: this process cannot host the survivor world
+            raise PeerLoss(
+                "peer loss detected on a non-coordinator host: only the "
+                "coordination-service host (process 0) can re-mesh; exiting"
+            )
+        t0 = time.perf_counter()
+        new_m = multihost.shrink_to_local()
+        mesh = self.survivor_mesh()
+        if self.counters is not None:
+            self.counters.bump("remeshes")
+        print(f"[crosscoder_tpu] elastic: re-meshed to epoch {new_m.epoch} "
+              f"({jax.device_count()} local devices, "
+              f"{1000 * (time.perf_counter() - t0):.0f} ms backend reset)",
+              flush=True, file=sys.stderr)
+        return mesh
+
+    def survivor_mesh(self):
+        """The standard ('data','model') mesh over the surviving world:
+        TP width (`model_axis_size`) is preserved — it shapes the
+        dictionary sharding the checkpoint's respec re-derives — and the
+        data axis takes every remaining device."""
+        model = max(1, int(self.cfg.model_axis_size))
+        n = jax.device_count()
+        if n % model:
+            raise PeerLoss(
+                f"survivor world has {n} devices, not divisible by "
+                f"model_axis_size={model}; cannot re-mesh"
+            )
+        return mesh_lib.make_mesh(n // model, model)

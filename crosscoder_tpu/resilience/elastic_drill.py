@@ -1,0 +1,250 @@
+"""Preemption drill: kill one host mid-run, watch the survivor re-mesh.
+
+The executable proof of the elastic membership path (``cfg.elastic``;
+docs/resilience.md "Elastic membership", docs/RUNBOOK.md preemption drill):
+
+- ``run_drill`` spawns TWO real processes over 4 virtual CPU devices each
+  (8-device ``data 2 × model 4`` mesh, gloo collectives), trains with
+  periodic saves, and has chaos kill process 1 abruptly (``die@N`` —
+  ``os._exit``, no notification) mid-run. Process 0 must detect the loss,
+  shrink to a single-process ``1 × 4`` world, restore-with-respec from the
+  newest verified save, and finish the run.
+- It then runs a third, CLEAN single-process child on the same ``1 × 4``
+  mesh restoring the exact save the survivor used. Determinism contract:
+  the survivor's post-remesh loss trajectory must be **bitwise equal** to
+  the clean restart's (same mesh ⇒ same HLO; same checkpoint ⇒ same state
+  and synthetic stream position — CPU float ops are run-to-run exact).
+
+The same module is the child entry point (``python -m
+crosscoder_tpu.resilience.elastic_drill --proc N ...``): children print a
+``{"ready": true}`` handshake line, then exactly one result JSON as the
+LAST stdout line. The parent helper is consumed by tests/test_elastic.py,
+the tier-1 preemption smoke (scripts/tier1.sh), and bench's ``elastic``
+leg (the drill's ``remesh_ms`` is the recovery-SLO headline).
+
+Synthetic-source by design: the drill exercises membership, re-mesh, and
+restore-with-respec; the mesh-sharded DATA plane's reshard determinism has
+its own single-process test (tests/test_elastic.py::test_buffer_reshard) —
+keeping the 2-process drill LM-free keeps it fast enough for tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+# one serve per step on the synthetic source, so die@N kills at step N's
+# batch production — after the liveness probe, before the step collective
+_DRILL = dict(steps=10, save_every=3, die_serve=7)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _drill_cfg(workdir: str, *, two_proc: bool, elastic: str, chaos: str = ""):
+    from crosscoder_tpu.config import CrossCoderConfig
+
+    return CrossCoderConfig(
+        d_in=32, dict_size=64, n_models=2, batch_size=16,
+        num_tokens=16 * 200, enc_dtype="fp32",
+        data_axis_size=2 if two_proc else 1, model_axis_size=4,
+        log_backend="null", checkpoint_dir=workdir, prefetch=False,
+        log_every=1, save_every=_DRILL["save_every"], stop_poll_every=1,
+        elastic=elastic, elastic_heartbeat_s=1.0, elastic_grace_s=3.0,
+        chaos=chaos,
+    )
+
+
+class _LossTape:
+    """Duck-typed MetricsLogger capturing (step, loss-bits) pairs."""
+
+    def __init__(self) -> None:
+        self.rows: list[tuple[int, str]] = []
+
+    def log(self, scalars: dict, step: int) -> None:
+        if "loss" in scalars:
+            # hex round-trips the exact float64 of the fetched f32 loss —
+            # the bitwise-equality channel between processes
+            self.rows.append((step, float(scalars["loss"]).hex()))
+
+    def close(self) -> None:
+        pass
+
+
+def _child(args: argparse.Namespace) -> dict:
+    import jax
+
+    from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.parallel import multihost
+    from crosscoder_tpu.resilience.chaos import Chaos
+    from crosscoder_tpu.train.trainer import Trainer
+
+    two_proc = args.proc >= 0
+    if two_proc:
+        multihost.elastic_initialize(
+            f"localhost:{args.port}", num_processes=2, process_id=args.proc,
+            heartbeat_s=1.0,
+        )
+        assert jax.device_count() == 8, jax.device_count()
+    cfg = _drill_cfg(
+        args.workdir, two_proc=two_proc,
+        elastic="on" if two_proc else "off",
+        chaos=f"die@{_DRILL['die_serve']}" if args.proc == 1 else "",
+    )
+    mesh = mesh_lib.mesh_from_cfg(cfg)
+    tape = _LossTape()
+    tr = Trainer(cfg, mesh=mesh, logger=tape,
+                 checkpointer=Checkpointer(args.workdir),
+                 chaos=Chaos.from_cfg_env(cfg))
+    print(  # contracts: allow(lint-no-stdout-print) — parent handshake
+        json.dumps({"proc": args.proc, "ready": True}), flush=True)
+    if args.restore_save >= 0:
+        # clean-restart leg: resume the exact world the survivor resumed
+        tr.restore(version_dir=os.path.join(args.workdir, "version_0"),
+                   save=args.restore_save)
+    tr.train(num_steps=_DRILL["steps"])
+    tr.close()
+    return {
+        "proc": args.proc,
+        "losses": tape.rows,
+        "remesh": getattr(tr, "last_remesh", None),
+        "counters": tr.resilience.snapshot(),
+        "final_step": int(tr.state.step),
+    }
+
+
+def _spawn(workdir: str, proc: int, port: int, restore_save: int = -1,
+           stderr_path: str | None = None) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # children must not inherit an outer multihost/chaos opt-in
+    for k in ("CROSSCODER_MULTIHOST", "JAX_COORDINATOR_ADDRESS",
+              "CROSSCODER_CHAOS"):
+        env.pop(k, None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "crosscoder_tpu.resilience.elastic_drill",
+         "--proc", str(proc), "--port", str(port), "--workdir", workdir,
+         "--restore-save", str(restore_save)],
+        stdout=subprocess.PIPE,
+        stderr=open(stderr_path, "w") if stderr_path else subprocess.DEVNULL,
+        text=True, env=env,
+    )
+
+
+def _result(p: subprocess.Popen, timeout: float) -> dict:
+    out, _ = p.communicate(timeout=timeout)
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+    if not lines:
+        raise RuntimeError(f"drill child produced no output (exit {p.returncode})")
+    return json.loads(lines[-1])
+
+
+def run_drill(workdir: str | None = None, timeout: float = 420.0,
+              keep_logs: bool = False) -> dict:
+    """The full preemption drill; returns a report dict with
+
+    - ``survivor``: proc 0's result (losses, remesh info, counters),
+    - ``restart``: the clean single-process child restoring the same save,
+    - ``post_losses`` / ``restart_losses``: the aligned post-remesh
+      trajectories (same steps, loss float hex),
+    - ``bitwise_equal``: whether they match exactly,
+    - ``remesh_ms``: the survivor's measured recovery wall time.
+
+    Raises on structural failure (child died without re-meshing, no saves,
+    restart could not restore); leaves the equality VERDICT to the caller
+    so tests can assert and bench can report.
+    """
+    tmp = None
+    if workdir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="elastic_drill_")
+        workdir = tmp.name
+    try:
+        logs = str(Path(workdir) / "drill_proc{}.err")
+        port = _free_port()
+        ps = [
+            _spawn(workdir, proc, port,
+                   stderr_path=logs.format(proc) if keep_logs else None)
+            for proc in (0, 1)
+        ]
+        survivor = _result(ps[0], timeout)
+        ps[1].wait(timeout=30)
+        if ps[0].returncode != 0:
+            raise RuntimeError(f"survivor exited {ps[0].returncode}")
+        if ps[1].returncode == 0:
+            raise RuntimeError("proc 1 exited cleanly; die@ chaos never fired")
+        remesh = survivor.get("remesh")
+        if not remesh or remesh.get("save", -1) < 0:
+            raise RuntimeError(f"survivor never re-meshed: {survivor}")
+
+        restart = _result(
+            _spawn(workdir, -1, port, restore_save=remesh["save"],
+                   stderr_path=logs.format("r") if keep_logs else None),
+            timeout,
+        )
+
+        resume_step = remesh["step"]
+        post = [r for r in survivor["losses"] if r[0] >= resume_step]
+        # the survivor logged steps >= resume_step twice: pre-death and
+        # post-remesh — keep the LAST run of each step (the replay)
+        seen: dict[int, str] = {}
+        for s, h in post:
+            seen[s] = h
+        post = sorted(seen.items())
+        restart_post = [tuple(r) for r in restart["losses"]
+                        if r[0] >= resume_step]
+        return {
+            "survivor": survivor,
+            "restart": restart,
+            "post_losses": post,
+            "restart_losses": restart_post,
+            "bitwise_equal": post == restart_post and len(post) > 0,
+            "remesh_ms": remesh["remesh_ms"],
+            "resume_step": resume_step,
+            "steps": _DRILL["steps"],
+        }
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--proc", type=int, default=None,
+                    help="child mode: 0/1 = elastic pair, -1 = clean restart")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--restore-save", type=int, default=-1)
+    ap.add_argument("--keep-logs", action="store_true")
+    args = ap.parse_args(argv)
+    if args.proc is None:
+        # parent mode: run the whole drill, report as the last stdout line
+        report = run_drill(workdir=args.workdir, keep_logs=args.keep_logs)
+        print(  # contracts: allow(lint-no-stdout-print) — one-line report
+            json.dumps({
+            "bitwise_equal": report["bitwise_equal"],
+            "remesh_ms": report["remesh_ms"],
+            "resume_step": report["resume_step"],
+            "post_steps": len(report["post_losses"]),
+        }))
+        return 0 if report["bitwise_equal"] else 1
+    result = _child(args)
+    print(  # contracts: allow(lint-no-stdout-print) — child result line
+        json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

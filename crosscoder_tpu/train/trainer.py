@@ -45,6 +45,8 @@ from crosscoder_tpu.config import CrossCoderConfig
 from crosscoder_tpu.models import crosscoder as cc
 from crosscoder_tpu.parallel import mesh as mesh_lib
 from crosscoder_tpu.parallel import multihost
+from crosscoder_tpu.obs import trace
+from crosscoder_tpu.resilience.elastic import PeerLoss
 from crosscoder_tpu.train import schedules
 from crosscoder_tpu.train.state import TrainState, init_train_state, make_optimizer
 from crosscoder_tpu.utils import pipeline
@@ -364,6 +366,16 @@ class Trainer:
                     backoff_s=cfg.harvest_backoff_s, name="harvest",
                     counters=self.resilience,
                 )
+        # elastic membership (cfg.elastic; resilience/elastic.py): liveness
+        # probes at the stop-poll cadence + survivor re-mesh on confirmed
+        # peer loss. None when off (default): the loop carries only is-None
+        # checks and the step HLO is byte-identical (contracts rule
+        # hlo-elastic-off-identity).
+        self._elastic = None
+        if cfg.elastic == "on":
+            from crosscoder_tpu.resilience.elastic import ElasticController
+
+            self._elastic = ElasticController(cfg, counters=self.resilience)
         # --- observability (cfg.obs; docs/OBSERVABILITY.md) ------------
         # None when off (the default): every hook below is a plain
         # is-None check — the compiled step HLO and the transfer counts
@@ -386,7 +398,7 @@ class Trainer:
             n_data=int(self.mesh.shape.get("data", 1)),
         )
         self._state_shardings = mesh_lib.state_shardings(self.mesh, state, cfg.shard_sources)
-        self.state = jax.device_put(state, self._state_shardings)
+        self.state = multihost.put_global(state, self._state_shardings)
         # the sparse backward plane's dispatch is static per cfg/batch —
         # announce it once so runs record WHICH backward they measured
         # (cfg.sparse_bwd="auto" silently stays dense off-TPU / without
@@ -467,8 +479,14 @@ class Trainer:
         # load_state_dict (any object with next() is allowed), the stream
         # is NOT rewound, so discarding would silently skip one batch.
         self._drain_prefetch()
-        state, meta = self.checkpointer.restore(self.cfg, self._tx, version_dir, save)
-        self.state = jax.device_put(state, self._state_shardings)
+        # n_data pins the respec template to THIS mesh (restore-with-respec:
+        # a checkpoint from a different layout restores fine, quant_ef
+        # residuals reset — see Checkpointer.restore)
+        state, meta = self.checkpointer.restore(
+            self.cfg, self._tx, version_dir, save,
+            n_data=int(self.mesh.shape.get("data", 1)),
+        )
+        self.state = multihost.put_global(state, self._state_shardings)
         # host mirror of the device step counter (aux_every variant choice
         # without a per-step sync); one sync here at restore is fine
         self._host_step = int(self.state.step)
@@ -516,7 +534,7 @@ class Trainer:
         else:
             vec = np.ones((self.cfg.n_sources,), np.float32)
         if self._scale_src is None or not np.array_equal(self._scale_src, vec):
-            self._scale_dev = jax.device_put(
+            self._scale_dev = multihost.put_global(
                 vec, NamedSharding(self.mesh, PartitionSpec())
             )
             self._scale_src = vec.copy()
@@ -577,7 +595,8 @@ class Trainer:
                 # stores — still the serve path's dispatch, counted as such)
                 self._obs.registry.count("comm/h2d_transfers")
             with self._dispatch_lock:
-                return jax.device_put(batch, self._batch_sharding), self._device_scale()
+                return (multihost.put_global(batch, self._batch_sharding),
+                        self._device_scale())
 
     def _submit_prefetch(self) -> None:
         # Stream-state snapshot BEFORE producing the next batch: a checkpoint
@@ -927,11 +946,16 @@ class Trainer:
         a collective on a multi-host mesh (process_allgather of
         non-addressable leaves); only process 0 writes files.
         """
-        if self.checkpointer is not None:
-            # quiesce the prefetch worker (no mid-next() device contention),
-            # then checkpoint the PRE-prefetch stream snapshot so resume
-            # replays the in-flight batch instead of skipping it
+        if self.checkpointer is not None and self.state is not None:
+            # quiesce the prefetch worker (no mid-next() device contention)
+            # AND the buffer's offloaded refill dispatcher (overlap engine:
+            # its thread mutates cycle state the stream snapshot reads —
+            # without the drain a save racing a dispatch could record a
+            # TORN snapshot), then checkpoint the PRE-prefetch stream
+            # snapshot so resume replays the in-flight batch instead of
+            # skipping it
             self._drain_prefetch()
+            self._quiesce_refill()
             buffer = self.buffer
             if self._pending is not None and self._buffer_snapshot is not None:
                 snap = self._buffer_snapshot
@@ -939,6 +963,111 @@ class Trainer:
             self.checkpointer.save(
                 self.state, self.cfg, buffer=buffer, background=background
             )
+
+    def _quiesce_refill(self) -> None:
+        """Drain the buffer's refill dispatcher so no background thread
+        mutates cycle state under a snapshot. A harvest error surfacing
+        from the drain must NOT abort the save in progress — the stream
+        snapshot is consistent either way (the cycle bookkeeping only
+        advances under the drained pump), and the final/SIGTERM save is
+        exactly when losing the checkpoint hurts most; the error is
+        reported and otherwise dropped (the run is exiting or will hit it
+        again on the next serve)."""
+        q = getattr(self.buffer, "_quiesce_dispatch", None)
+        if q is None:
+            return
+        try:
+            q()
+        except Exception as e:
+            print(f"[crosscoder_tpu] refill drain raised during save "
+                  f"quiesce ({type(e).__name__}: {e}); saving anyway"[:400],
+                  flush=True, file=sys.stderr)
+
+    def _remesh_and_resume(self, cause: BaseException) -> None:
+        """Survivor recovery (cfg.elastic; docs/resilience.md "Elastic
+        membership"): quiesce every consumer of the dying backend, shrink
+        the world to this host's local devices, re-derive the mesh-coupled
+        trainer pieces, and restore from the newest verified checkpoint.
+        On hosts that cannot survive (non-coordinator — the coordination
+        service died with its host) the shrink raises :class:`PeerLoss`,
+        which propagates and ends the run there. Full recovery wall time
+        accumulates in ``resilience/remesh_ms``."""
+        t0 = time.perf_counter()
+        with trace.span("remesh"):
+            print(f"[crosscoder_tpu] elastic: peer loss confirmed "
+                  f"({type(cause).__name__}); re-meshing over survivors",
+                  flush=True, file=sys.stderr)
+            # 1. quiesce: nothing may touch the dying backend past here.
+            #    The prefetched batch (if any) belongs to the dead world;
+            #    its production may itself have died on the torn collective.
+            try:
+                self._drain_prefetch(discard=True)
+            except Exception:
+                pass
+            self._pending = None
+            self._buffer_snapshot = None
+            self._quiesce_refill()
+            if hasattr(self.buffer, "prepare_reshard"):
+                # park the LM params to host BEFORE the backend reset
+                # invalidates every live device array
+                self.buffer.prepare_reshard()
+            if self.checkpointer is not None:
+                try:
+                    self.checkpointer.wait()  # land any background write
+                except Exception:
+                    pass
+            # 2. shrink: tear down the distributed runtime, bump the mesh
+            #    epoch, reset the backend (all device buffers die here)
+            mesh = self._elastic.shrink()
+            # 3. re-derive everything the old mesh shaped
+            self._rebuild_for_mesh(mesh)
+            if hasattr(self.buffer, "reshard"):
+                # refill=False: restore() below replays the CHECKPOINT's
+                # buffer snapshot, not the dead live stream
+                self.buffer.reshard(self._batch_sharding, refill=False)
+            # 4. restore-with-respec from the newest verified checkpoint
+            meta = self.restore()
+        ms = 1000 * (time.perf_counter() - t0)
+        # which world the survivor resumed from — drills/tests read this to
+        # replay the identical restore on a clean restart
+        self.last_remesh = {
+            "step": int(meta.get("step", -1)),
+            "save": int(meta.get("save_version", -1)),
+            "epoch": self._elastic.epoch(),
+            "remesh_ms": int(ms),
+        }
+        self.resilience.bump("remesh_ms", int(ms))
+        print(f"[crosscoder_tpu] elastic: resumed at step "
+              f"{self._host_step} on mesh {dict(self.mesh.shape)} "
+              f"({ms:.0f} ms recovery)", flush=True, file=sys.stderr)
+
+    def _rebuild_for_mesh(self, mesh) -> None:
+        """Point every mesh-coupled trainer piece at ``mesh``: shardings,
+        the compiled step-variant cache (cleared — ``step()`` recompiles
+        lazily on the new mesh), the batch sharding, the serve-path scale
+        cache, the resample fn, and the launch sequencer (the post-shrink
+        world is single-process, so ticketed dispatch ordering retires).
+        The live ``state`` is dropped — its buffers died with the old
+        backend; the caller restores from checkpoint."""
+        cfg = self.cfg
+        self.mesh = mesh
+        template = init_train_state(
+            jax.random.key(cfg.seed), cfg, self._tx,
+            n_data=int(mesh.shape.get("data", 1)),
+        )
+        self._state_shardings = mesh_lib.state_shardings(
+            mesh, template, cfg.shard_sources
+        )
+        self.state = None
+        self._step_fns = {}
+        self._host_step = 0
+        self._batch_sharding = mesh_lib.batch_sharding(mesh)
+        self._scale_dev = None
+        self._scale_src = None
+        self._resample_fn = None
+        self._sequencer = None
+        if cfg.prefetch and multihost.needs_launch_tickets():
+            self._sequencer = pipeline.LaunchSequencer()
 
     def train(self, num_steps: int | None = None) -> dict[str, float]:
         """Run the training loop (reference ``trainer.py:72-82`` semantics:
@@ -1065,59 +1194,92 @@ class Trainer:
                     self._obs.take_blocked_s()
                 if profiler is not None:
                     profiler.begin_stretch(start)
-                for i in progress:
-                    if _stop_agreed(i):
-                        break
-                    if profiler is not None:
-                        profiler.before_step(i)
-                    metrics = self.step(full_metrics=(i % self.cfg.log_every == 0))
-                    if profiler is not None:
-                        # the sync fetch runs only when a window actually
-                        # closes at this step — the fast path stays free
-                        # of device round-trips
-                        profiler.after_step(
-                            i, sync=lambda: float(jax.device_get(metrics["loss"]))
-                        )
-                    if i % self.cfg.log_every == 0:
-                        # sync via a scalar fetch: block_until_ready is not an
-                        # execution barrier under remote-tunnel TPU clients
-                        loss_val = float(jax.device_get(metrics["loss"]))
-                        if self._obs is not None:
-                            self._obs.registry.count("comm/d2h_transfers")
-                        if guard and self._loss_diverged(loss_val):
-                            # the guard reuses the loss this log step just
-                            # fetched — detection itself adds no host sync
-                            if profiler is not None:
-                                # end an active capture before the stretch
-                                # restarts, or the next start_trace raises
-                                # mid-recovery
-                                profiler.stop_if_active()
-                            getattr(progress, "close", lambda: None)()
-                            self._rollback(i)
-                            rolled_back = True
-                            break
-                        now = time.perf_counter()
-                        metrics = dict(metrics)
-                        metrics["step_time_ms"] = 1000 * (now - last_log_t) / max(i - last_log_i, 1)
-                        if self._obs is not None:
-                            # refill-bubble attribution: the fraction of
-                            # this log interval's wall-clock the loop spent
-                            # BLOCKED on batch production (VERDICT r5's
-                            # refill-bubble criterion, now measurable in
-                            # every run rather than only in bench phase B)
-                            wall_s = max(now - last_log_t, 1e-9)
-                            reg = self._obs.registry
-                            reg.gauge("perf/step_wall_ms", metrics["step_time_ms"])
-                            reg.gauge(
-                                "perf/refill_bubble_frac",
-                                min(1.0, self._obs.take_blocked_s() / wall_s),
+                try:
+                    for i in progress:
+                        # elastic liveness probe (cfg.elastic; one
+                        # bounded membership barrier at the stop-poll
+                        # cadence — same steps on every process, so the
+                        # barrier keys stay SPMD-consistent)
+                        if (self._elastic is not None
+                                and self._elastic.should_probe(i)
+                                and not self._elastic.probe(i)):
+                            raise PeerLoss(
+                                f"peer lost (liveness probe, step {i})"
                             )
-                        last_log_t, last_log_i = now, i
-                        self.log(metrics, step=i)
-                    if (i + 1) % self.cfg.save_every == 0:
-                        # background: the file write overlaps subsequent steps;
-                        # only the device→host fetch blocks the loop
-                        self.save(background=True)
+                        if _stop_agreed(i):
+                            break
+                        if profiler is not None:
+                            profiler.before_step(i)
+                        metrics = self.step(full_metrics=(i % self.cfg.log_every == 0))
+                        if profiler is not None:
+                            # the sync fetch runs only when a window actually
+                            # closes at this step — the fast path stays free
+                            # of device round-trips
+                            profiler.after_step(
+                                i, sync=lambda: float(jax.device_get(metrics["loss"]))
+                            )
+                        if i % self.cfg.log_every == 0:
+                            # sync via a scalar fetch: block_until_ready is not an
+                            # execution barrier under remote-tunnel TPU clients
+                            loss_val = float(jax.device_get(metrics["loss"]))
+                            if self._obs is not None:
+                                self._obs.registry.count("comm/d2h_transfers")
+                            if guard and self._loss_diverged(loss_val):
+                                # the guard reuses the loss this log step just
+                                # fetched — detection itself adds no host sync
+                                if profiler is not None:
+                                    # end an active capture before the stretch
+                                    # restarts, or the next start_trace raises
+                                    # mid-recovery
+                                    profiler.stop_if_active()
+                                getattr(progress, "close", lambda: None)()
+                                self._rollback(i)
+                                rolled_back = True
+                                break
+                            now = time.perf_counter()
+                            metrics = dict(metrics)
+                            metrics["step_time_ms"] = 1000 * (now - last_log_t) / max(i - last_log_i, 1)
+                            if self._obs is not None:
+                                # refill-bubble attribution: the fraction of
+                                # this log interval's wall-clock the loop spent
+                                # BLOCKED on batch production (VERDICT r5's
+                                # refill-bubble criterion, now measurable in
+                                # every run rather than only in bench phase B)
+                                wall_s = max(now - last_log_t, 1e-9)
+                                reg = self._obs.registry
+                                reg.gauge("perf/step_wall_ms", metrics["step_time_ms"])
+                                reg.gauge(
+                                    "perf/refill_bubble_frac",
+                                    min(1.0, self._obs.take_blocked_s() / wall_s),
+                                )
+                            last_log_t, last_log_i = now, i
+                            self.log(metrics, step=i)
+                        if (i + 1) % self.cfg.save_every == 0:
+                            # background: the file write overlaps subsequent steps;
+                            # only the device→host fetch blocks the loop
+                            self.save(background=True)
+                except Exception as exc:
+                    # elastic membership: was that a DYING PEER tearing
+                    # a collective out from under this process, or an
+                    # ordinary software error? PeerLoss (a failed
+                    # liveness probe) is already confirmed; anything
+                    # else asks one more bounded membership barrier.
+                    # Unconfirmed errors re-raise unchanged — with
+                    # elastic off this handler is a bare re-raise.
+                    if self._elastic is None or not (
+                        isinstance(exc, PeerLoss)
+                        or self._elastic.confirm_peer_loss(exc)
+                    ):
+                        raise
+                    if profiler is not None:
+                        profiler.stop_if_active()
+                    getattr(progress, "close", lambda: None)()
+                    self._remesh_and_resume(exc)
+                    # the world changed shape: the survivor runs single-
+                    # process now, so the stop/final-save paths must
+                    # re-read the binding
+                    multi_process = jax.process_count() > 1
+                    rolled_back = True
                 if not rolled_back:
                     break
             clean = True

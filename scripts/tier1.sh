@@ -19,4 +19,9 @@ fi
 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_refill_overlap.py::test_overlap_stream_identity_host \
     -q -p no:cacheprovider || exit 1
+# elastic preemption smoke: 2 real CPU processes, chaos kills one mid-run,
+# the survivor must re-mesh and finish bitwise-equal to a clean restart
+# (docs/resilience.md "Elastic membership"; exit 0 iff bitwise_equal)
+env JAX_PLATFORMS=cpu python -m crosscoder_tpu.resilience.elastic_drill \
+    || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

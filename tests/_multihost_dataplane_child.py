@@ -24,7 +24,9 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(
+from crosscoder_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize(
     coordinator_address=f"localhost:{port}", num_processes=2, process_id=proc_id
 )
 assert jax.device_count() == 8 and jax.local_device_count() == 4

@@ -1,0 +1,206 @@
+"""Elastic multihost membership (cfg.elastic; resilience/elastic.py).
+
+Fast tests cover the membership layer's single-process degenerations, the
+chaos grammar's preemption faults, and config validation. The slow test is
+the real thing: the 2-process preemption drill
+(crosscoder_tpu/resilience/elastic_drill.py) — chaos kills process 1
+mid-run with ``os._exit``, process 0 must detect the loss, shrink to its
+local devices, restore-with-respec from the newest verified save, and
+finish with a post-remesh loss trajectory BITWISE equal to a clean
+single-process restart from the same checkpoint.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.parallel import multihost
+from crosscoder_tpu.resilience.chaos import Chaos
+from crosscoder_tpu.resilience.elastic import ElasticController, PeerLoss
+
+
+def _cfg(**kw):
+    base = dict(d_in=32, dict_size=64, n_models=2, batch_size=16,
+                num_tokens=16 * 50, log_backend="null")
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar: the two host-loss faults
+
+
+def test_chaos_parses_preempt_and_die():
+    c = Chaos.parse("preempt@3,die@5,nan@1")
+    assert c.preempt_serves == (3,)
+    assert c.die_serves == (5,)
+    assert c.nan_serves == (1,)
+
+
+def test_chaos_preempt_sends_sigterm():
+    import signal
+
+    got = []
+    old = signal.signal(signal.SIGTERM, lambda *a: got.append(True))
+    try:
+        Chaos.parse("preempt@0").on_serve(0)
+    finally:
+        signal.signal(signal.SIGTERM, old)
+    assert got == [True]
+
+
+def test_chaos_preempt_fires_once():
+    import signal
+
+    got = []
+    old = signal.signal(signal.SIGTERM, lambda *a: got.append(True))
+    try:
+        c = Chaos.parse("preempt@2")
+        for serve in (0, 1, 2, 2, 3):
+            c.on_serve(serve)
+    finally:
+        signal.signal(signal.SIGTERM, old)
+    assert got == [True]
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+
+
+def test_elastic_config_fields():
+    cfg = _cfg(elastic="on", elastic_heartbeat_s=2.0, elastic_grace_s=7.0)
+    assert cfg.elastic == "on"
+    assert cfg.elastic_heartbeat_s == 2.0
+    assert cfg.elastic_grace_s == 7.0
+    assert _cfg().elastic == "off"          # default: zero-cost off
+
+
+def test_elastic_config_validation():
+    with pytest.raises(ValueError, match="elastic"):
+        _cfg(elastic="maybe")
+    with pytest.raises(ValueError, match="seq_shards"):
+        _cfg(elastic="on", seq_shards=2, model_batch_size=4)
+
+
+# ---------------------------------------------------------------------------
+# membership layer: single-process degenerations (the multi-process truths
+# are proven by the drill below and tests/test_multihost_ckpt.py)
+
+
+def test_membership_none_outside_elastic_runtime():
+    assert multihost.membership() is None
+    assert not multihost.peer_loss_flagged()
+    # a probe outside any elastic world is vacuously healthy
+    assert multihost.probe_liveness("p0", timeout_s=0.1)
+
+
+def test_controller_inactive_single_process():
+    ctl = ElasticController(_cfg(elastic="on"))
+    assert not ctl.active()
+    assert ctl.epoch() == 0
+    assert not ctl.should_probe(0)
+    # an ordinary software error is never a peer loss without a membership
+    assert not ctl.confirm_peer_loss(RuntimeError("boom"))
+    with pytest.raises(PeerLoss, match="no elastic membership"):
+        ctl.shrink()
+
+
+def test_survivor_mesh_preserves_tp_width():
+    ctl = ElasticController(_cfg(elastic="on", model_axis_size=4))
+    mesh = ctl.survivor_mesh()
+    assert mesh.shape["model"] == 4
+    assert mesh.shape["data"] == jax.device_count() // 4
+
+
+def test_trainer_elastic_off_has_no_controller():
+    from crosscoder_tpu.train.trainer import Trainer
+
+    tr = Trainer(_cfg())
+    assert tr._elastic is None
+    tr.close()
+
+
+def test_put_global_matches_device_put():
+    """The collective-free placement helper must be a drop-in for
+    device_put on the single-process meshes every other test uses."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh(-1, 1)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    sh = NamedSharding(mesh, P("data", None))
+    a = multihost.put_global(x, sh)
+    b = jax.device_put(x, sh)
+    assert a.sharding.is_equivalent_to(b.sharding, a.ndim)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# buffer reshard: the data-plane leg of the elastic recovery
+
+
+@pytest.mark.slow
+def test_buffer_reshard_stream_determinism():
+    """Reshard a mesh-sharded HBM buffer (data 2 × model 4 → 1 × 8 batch
+    layout) mid-stream: the served sequence after ``reshard(refill=True)``
+    must equal a fresh buffer on the NEW sharding restored from the same
+    stream snapshot (provenance rebuild, determinism A2)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crosscoder_tpu.data.buffer import make_buffer
+    from crosscoder_tpu.models import lm
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+
+    lm_cfg = lm.LMConfig.tiny()
+    params = [lm.init_params(jax.random.key(0), lm_cfg),
+              lm.init_params(jax.random.key(1), lm_cfg)]
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, 257, size=(256, 17), dtype=np.int64)
+    cfg = CrossCoderConfig(
+        batch_size=32, buffer_mult=32, seq_len=17, d_in=32, n_models=2,
+        model_batch_size=4, norm_calib_batches=2, seed=3,
+        hook_point="blocks.2.hook_resid_pre", buffer_device="hbm",
+    )
+    wide = NamedSharding(mesh_lib.make_mesh(2, 4), P("data", None))
+    narrow = NamedSharding(mesh_lib.make_mesh(1, 8), P("data", None))
+
+    b = make_buffer(cfg, lm_cfg, params, tokens, batch_sharding=wide)
+    for _ in range(5):
+        b.next()
+    snap = b.state_dict()
+
+    b.prepare_reshard()             # parks LM params to host numpy
+    b.reshard(narrow, refill=True)  # re-allocs the store, replays the snap
+
+    ref = make_buffer(cfg, lm_cfg, params, tokens, batch_sharding=narrow,
+                      lazy=True)
+    ref.load_state_dict(snap)
+    for step in range(6):
+        np.testing.assert_array_equal(
+            np.asarray(b.next(), np.float32),
+            np.asarray(ref.next(), np.float32), err_msg=f"step {step}")
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: 2 REAL processes, one dies, the survivor re-meshes
+
+
+@pytest.mark.slow
+def test_preemption_drill_bitwise_recovery(tmp_path):
+    from crosscoder_tpu.resilience.elastic_drill import run_drill
+
+    report = run_drill(workdir=str(tmp_path), keep_logs=True)
+    assert report["bitwise_equal"], {
+        "post": report["post_losses"], "restart": report["restart_losses"]}
+    assert report["post_losses"], "no post-remesh steps ran"
+    assert report["remesh_ms"] > 0
+    surv = report["survivor"]
+    assert surv["counters"].get("resilience/remeshes") == 1
+    assert surv["counters"].get("resilience/remesh_ms", 0) >= 1
+    assert surv["final_step"] == report["steps"]
+    # the survivor resumed from the newest save BEFORE the death
+    assert report["resume_step"] == surv["remesh"]["step"]
+    assert surv["remesh"]["epoch"] == 1

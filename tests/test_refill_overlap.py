@@ -323,3 +323,73 @@ def test_refill_overlap_config_validation():
     with pytest.raises(ValueError, match="refill_dispatch_batch"):
         make_cfg(refill_dispatch_batch=0)
     make_cfg(refill_overlap="on", refill_dispatch_batch=1)   # valid corner
+
+
+# ---------------------------------------------------------------------------
+# final-save quiesce (the SIGTERM/stop path): the trainer must drain the
+# offloaded dispatcher BEFORE snapshotting stream state for a save
+
+
+def test_save_drains_dispatcher_before_writer(tmp_path, lm_pair, tokens):
+    """tr.save() with refill_overlap=on: the dispatcher drain must happen
+    before the checkpoint writer sees the state — a snapshot taken while
+    the pump thread mutates cycle bookkeeping could tear."""
+    from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+    from crosscoder_tpu.train.trainer import Trainer
+
+    lm_cfg, params = lm_pair
+    cfg = make_cfg(refill_overlap="on", checkpoint_dir=str(tmp_path),
+                   log_backend="null", prefetch=False)
+    buf = PairedActivationBuffer(cfg, lm_cfg, params, tokens)
+    assert buf._dispatcher is not None
+    tr = Trainer(cfg, buffer=buf, checkpointer=Checkpointer(cfg=cfg))
+    order = []
+    real_q = buf._quiesce_dispatch
+    real_save = tr.checkpointer.save
+    buf._quiesce_dispatch = lambda: (order.append("drain"), real_q())[1]
+    tr.checkpointer.save = (
+        lambda *a, **k: (order.append("write"), real_save(*a, **k))[1])
+    tr.step()
+    tr.save()
+    assert "drain" in order and "write" in order
+    assert order.index("drain") < order.index("write")
+    tr.close()
+
+
+def test_save_survives_drain_failure_and_close_is_idempotent(
+        tmp_path, lm_pair, tokens):
+    """A dispatcher drain that RAISES at final-save time must not cost the
+    checkpoint (that save is the whole point of the stop path): the save
+    still lands, verifies, and restores to the same state; close() runs
+    clean afterwards — twice (the finally + atexit double-close)."""
+    from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+    from crosscoder_tpu.train.trainer import Trainer
+
+    lm_cfg, params = lm_pair
+    cfg = make_cfg(refill_overlap="on", checkpoint_dir=str(tmp_path),
+                   log_backend="null", prefetch=False)
+    buf = PairedActivationBuffer(cfg, lm_cfg, params, tokens)
+    tr = Trainer(cfg, buffer=buf, checkpointer=Checkpointer(cfg=cfg))
+    tr.step()
+    tr.step()
+    want_step = int(tr.state.step)
+    want = {k: np.asarray(v, np.float32) for k, v in tr.state.params.items()}
+
+    def boom():
+        raise RuntimeError("chaos: drain torn")
+
+    buf._quiesce_dispatch = boom
+    tr.save()                                   # must not raise
+    tr.close()
+    tr.close()                                  # idempotent double-close
+
+    tr2 = Trainer(cfg, buffer=PairedActivationBuffer(
+        cfg, lm_cfg, params, tokens, lazy=True),
+        checkpointer=Checkpointer(cfg=cfg))
+    meta = tr2.restore()
+    assert int(meta["step"]) == want_step
+    for k in want:
+        np.testing.assert_array_equal(
+            np.asarray(tr2.state.params[k], np.float32), want[k], err_msg=k)
+    assert np.isfinite(float(jax.device_get(tr2.step()["loss"])))
+    tr2.close()

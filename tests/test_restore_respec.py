@@ -1,0 +1,158 @@
+"""Restore-with-respec (Checkpointer.restore(n_data=...)) at fixed
+membership: a checkpoint written under one mesh layout restores onto a
+different one. The TrainState is layout-free on disk, so the only
+mesh-shaped piece is the quant_grads error-feedback residual
+(``aux["quant_ef"]``, leading dim = data-axis width): respec drops it,
+re-creates it, or resets it to the template zero-init when the widths
+disagree — everything else round-trips exactly. This is the in-process
+half of the elastic recovery story (tests/test_elastic.py runs the
+2-process drill); it also covers deliberate topology changes between runs
+(TP-only ↔ DP×TP).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.parallel import mesh as mesh_lib
+from crosscoder_tpu.train.trainer import Trainer
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the 8-virtual-device CPU harness")
+
+
+def _cfg(workdir, **kw):
+    base = dict(d_in=32, dict_size=64, n_models=2, batch_size=16,
+                num_tokens=16 * 100, enc_dtype="fp32", log_backend="null",
+                checkpoint_dir=str(workdir), prefetch=False,
+                quant_grads=True, quant_block=32)
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+def _ef_widths(state):
+    aux = state.aux or {}
+    if "quant_ef" not in aux:
+        return None
+    return {int(np.asarray(l).shape[0])
+            for l in jax.tree_util.tree_leaves(aux["quant_ef"])}
+
+
+class _Tape:
+    def __init__(self):
+        self.rows = []
+
+    def log(self, scalars, step):
+        if "loss" in scalars:
+            self.rows.append((step, float(scalars["loss"]).hex()))
+
+    def close(self):
+        pass
+
+
+def test_tp_to_dptp_round_trip(tmp_path):
+    """Save under TP-only (1×8, no quant_ef) → restore onto DP×TP (2×4):
+    quant_ef is created fresh at the new width; params/opt/step round-trip
+    exactly. Then back: the 2-wide quant_ef is dropped on the way to 1×8."""
+    cfg = _cfg(tmp_path)
+    tp = mesh_lib.make_mesh(1, 8)
+    dptp = mesh_lib.make_mesh(2, 4)
+
+    a = Trainer(cfg, mesh=tp, checkpointer=Checkpointer(base_dir=tmp_path))
+    assert _ef_widths(a.state) is None          # n_data=1: no residuals
+    for _ in range(2):
+        a.step()
+    a.save()
+    want = {k: np.asarray(Checkpointer._fetch_global(v), np.float32)
+            for k, v in a.state.params.items()}
+    a.close()
+
+    b = Trainer(cfg, mesh=dptp, checkpointer=Checkpointer(base_dir=tmp_path))
+    meta = b.restore()
+    assert int(meta["step"]) == 2
+    assert _ef_widths(b.state) == {2}           # respec created them
+    for k in want:
+        np.testing.assert_array_equal(
+            np.asarray(Checkpointer._fetch_global(b.state.params[k]),
+                       np.float32), want[k], err_msg=k)
+    assert np.isfinite(float(jax.device_get(b.step()["loss"])))
+    b.save()
+    b.close()
+
+    c = Trainer(cfg, mesh=tp, checkpointer=Checkpointer(base_dir=tmp_path))
+    meta = c.restore()
+    assert int(meta["step"]) == 3
+    assert _ef_widths(c.state) is None          # respec dropped them
+    assert np.isfinite(float(jax.device_get(c.step()["loss"])))
+    c.close()
+
+
+def test_mismatched_ef_width_resets(tmp_path):
+    """A 2-wide quant_ef checkpoint restored onto a 4-wide mesh: the
+    residuals cannot be re-laid-out (they are per-device error feedback),
+    so respec resets them to the template zero-init at the NEW width."""
+    cfg = _cfg(tmp_path)
+    a = Trainer(cfg, mesh=mesh_lib.make_mesh(2, 4),
+                checkpointer=Checkpointer(base_dir=tmp_path))
+    a.step()
+    a.save()
+    a.close()
+
+    b = Trainer(cfg, mesh=mesh_lib.make_mesh(4, 2),
+                checkpointer=Checkpointer(base_dir=tmp_path))
+    b.restore()
+    assert _ef_widths(b.state) == {4}
+    for leaf in jax.tree_util.tree_leaves((b.state.aux or {})["quant_ef"]):
+        np.testing.assert_array_equal(np.asarray(leaf), 0)
+    assert np.isfinite(float(jax.device_get(b.step()["loss"])))
+    b.close()
+
+
+def test_same_mesh_restores_are_bitwise_deterministic(tmp_path):
+    """Two independent restores of one checkpoint onto the SAME mesh must
+    replay bitwise-identical loss trajectories (synthetic stream + CPU
+    float ops are run-to-run exact) — the determinism contract the elastic
+    drill leans on for its survivor-vs-clean-restart comparison."""
+    cfg = _cfg(tmp_path, save_every=1000, log_every=1)
+    mesh = mesh_lib.make_mesh(2, 4)
+    a = Trainer(cfg, mesh=mesh, checkpointer=Checkpointer(base_dir=tmp_path))
+    for _ in range(3):
+        a.step()
+    a.save()
+    a.close()
+
+    tapes = []
+    for _ in range(2):
+        tape = _Tape()
+        t = Trainer(cfg, mesh=mesh, logger=tape,
+                    checkpointer=Checkpointer(base_dir=tmp_path))
+        # pin the exact save: the first replay's own end-of-train save must
+        # not become the second replay's (newer) restore point
+        t.restore(version_dir=tmp_path / "version_0", save=0)
+        t.train(num_steps=6)
+        t.close()
+        tapes.append(tape.rows)
+    assert tapes[0] == tapes[1]
+    assert len(tapes[0]) == 3                   # steps 3..5 replayed once
+
+
+def test_foreign_extra_ef_is_tolerated_positionally_strict(tmp_path):
+    """The positional (legacy leaf_i) layout keeps the strict count
+    contract — respec only relaxes PATH-KEYED checkpoints, so old-format
+    saves cannot silently mis-pair leaves."""
+    cfg = _cfg(tmp_path)
+    a = Trainer(cfg, mesh=mesh_lib.make_mesh(2, 4),
+                checkpointer=Checkpointer(base_dir=tmp_path))
+    a.step()
+    a.save()
+    a.close()
+
+    vdir = tmp_path / "version_0"
+    import numpy as _np
+    with _np.load(vdir / "0_train_state.npz") as z:
+        keys = list(z.keys())
+    assert any("quant_ef" in k for k in keys), keys
+    assert not all(k.startswith("leaf_") for k in keys)
