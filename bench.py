@@ -1166,7 +1166,8 @@ def section_elastic() -> dict:
     trajectory bitwise equal to a clean restart. The drill always runs
     CPU subprocesses with their own virtual-device worlds, so this leg
     behaves identically on a TPU box."""
-    from crosscoder_tpu.resilience.elastic_drill import run_drill
+    from crosscoder_tpu.resilience.elastic_drill import (run_autoscale_drill,
+                                                         run_drill)
 
     report = run_drill()
     out = {
@@ -1175,8 +1176,23 @@ def section_elastic() -> dict:
         "resume_step": report["resume_step"],
         "post_steps": len(report["post_losses"]),
         "workload": "2-proc CPU drill: die@7 → detect → remesh → "
-                    "respec-restore → bitwise-equal finish",
+                    "respec-restore → bitwise-equal finish; then the full "
+                    "autoscale cycle (die → shrink → return-grant → "
+                    "debounced rejoin → grow → bitwise-equal finish)",
     }
+    # scale-UP SLO (docs/resilience.md "Elastic scale-up"): the full
+    # grow/shrink/grow cycle, with the grow recovery (boundary save +
+    # rendezvous + wider-world re-formation + restore) timed separately
+    # from the end-to-end drill wall time
+    t0 = time.perf_counter()
+    cycle = run_autoscale_drill()
+    out.update({
+        "grow_ms": cycle["grow_ms"],
+        "autoscale_bitwise_equal": bool(cycle["bitwise_equal"]),
+        "joiner_equal": bool(cycle["joiner_equal"]),
+        "autoscale_cycle_s": round(time.perf_counter() - t0, 2),
+        "autoscale_resume_step": cycle["resume_step"],
+    })
     log(f"[elastic] {out}")
     return out
 
@@ -1191,11 +1207,13 @@ _SUMMARY_KEYS = {
     "quant": ("roundtrip_rel_mse", "quality_gate_ok"),
     "obs": ("obs_overhead_frac", "overhead_gate_ok"),
     "dash": ("steady_s", "vs_reference"),
-    "elastic": ("remesh_ms", "bitwise_equal"),
+    "elastic": ("remesh_ms", "bitwise_equal", "grow_ms",
+                "autoscale_cycle_s"),
 }
 _GATES = (("refill_overlap", "gate_ok"), ("quant", "quality_gate_ok"),
           ("obs", "overhead_gate_ok"), ("e2e", "loss_finite"),
-          ("elastic", "bitwise_equal"))
+          ("elastic", "bitwise_equal"),
+          ("elastic", "autoscale_bitwise_equal"))
 
 
 def _compact(headline: dict, results: dict) -> dict:

@@ -209,11 +209,16 @@ KNOB_OFF_LATTICE: tuple[tuple[str, dict[str, Any]], ...] = (
     ("refill_overlap", dict(refill_overlap="on", refill_dispatch_batch=8)),
     ("elastic", dict(elastic="on", elastic_heartbeat_s=2.0,
                      elastic_grace_s=9.0)),
+    ("elastic_grow", dict(elastic="on", elastic_grow="on",
+                          checkpoint_dir="/tmp/ckpt",
+                          elastic_suspect_probes=3, elastic_dwell_steps=5,
+                          elastic_grow_debounce=4, elastic_policy="score")),
     ("all_knobs", dict(quant_buffer=True, quant_block=8, obs="on",
                        harvest_runtime="paged", page_size=16, seq_len=1024,
                        guard_loss=True, log_backend="jsonl",
                        refill_overlap="on", refill_dispatch_batch=8,
-                       elastic="on")),
+                       elastic="on", elastic_grow="on",
+                       checkpoint_dir="/tmp/ckpt")),
 )
 
 # the sparse/fused tiers: "off" vs a dead "auto" (no kernel live) must be
@@ -325,6 +330,27 @@ def _check_elastic_off(ctx: StepContext) -> list[Finding]:
             message="elastic/elastic_heartbeat_s/elastic_grace_s changed "
                     "the compiled step program — membership must be "
                     "invisible to the step lowering",
+        ))
+    return out
+
+
+def _check_elastic_grow_off(ctx: StepContext) -> list[Finding]:
+    """The scale-UP plane (``cfg.elastic_grow`` plus the hysteresis and
+    fleet-policy knobs) is pure control plane on top of elastic
+    membership: rendezvous-board polling, debounce/dwell bookkeeping, and
+    the mesh-shape policy all run on the host between steps, so with
+    every grow knob set the TRAIN STEP must still lower byte-identically
+    to the bare baseline (docs/resilience.md "Elastic scale-up"). Own
+    rule, own mutation self-test, own name in the report."""
+    out = []
+    for a, b, knob in ctx.identity_pairs:
+        if knob != "elastic_grow" or ctx.texts[a] == ctx.texts[b]:
+            continue
+        out.append(Finding(
+            rule="hlo-elastic-grow-off-identity", location=f"{a} vs {b}",
+            message="elastic_grow/suspect_probes/dwell/debounce/policy "
+                    "changed the compiled step program — the autoscale "
+                    "plane must be invisible to the step lowering",
         ))
     return out
 
@@ -443,6 +469,9 @@ HLO_RULES: list[Rule] = [
     Rule("hlo-elastic-off-identity",
          "elastic membership never changes the step lowering",
          _is_step_ctx, _check_elastic_off),
+    Rule("hlo-elastic-grow-off-identity",
+         "the elastic scale-up plane never changes the step lowering",
+         _is_step_ctx, _check_elastic_grow_off),
 ]
 
 

@@ -76,6 +76,15 @@ def _mut_elastic() -> StepContext:
     return ctx
 
 
+def _mut_elastic_grow() -> StepContext:
+    ctx = _step_ctx()
+    ctx.texts["off:elastic_grow"] = _CLEAN_HLO + "// an extra lowered op\n"
+    ctx.meta["off:elastic_grow"] = VariantMeta(n_donated_leaves=1)
+    ctx.jaxpr_consts["off:elastic_grow"] = []
+    ctx.identity_pairs = [("base", "off:elastic_grow", "elastic_grow")]
+    return ctx
+
+
 def _mut_s8() -> StepContext:
     ctx = _step_ctx()
     ctx.texts["base"] += "  %q = stablehlo.convert : tensor<32x8xi8>\n"
@@ -214,6 +223,7 @@ MUTATIONS: dict[str, Callable[[], Any]] = {
     "hlo-knob-off-identity": _mut_identity,
     "hlo-refill-overlap-off-identity": _mut_refill_overlap,
     "hlo-elastic-off-identity": _mut_elastic,
+    "hlo-elastic-grow-off-identity": _mut_elastic_grow,
     "hlo-no-s8-when-quant-off": _mut_s8,
     "hlo-no-f64": _mut_f64,
     "hlo-donation-honored": _mut_donation,

@@ -375,6 +375,46 @@ class CrossCoderConfig:
                                     # liveness barrier — a peer slower than
                                     # this at a poll point is declared lost
                                     # (the slow-host SLO; >= heartbeat)
+    elastic_suspect_probes: int = 2 # elastic="on": consecutive failed
+                                    # liveness probes before peer loss is
+                                    # DECLARED. Misses below the threshold
+                                    # are absorbed (resilience/
+                                    # elastic_suspects counter) so a flaky
+                                    # or slow host triggers hysteresis, not
+                                    # a remesh; torn-collective
+                                    # confirmation stays immediate (a dead
+                                    # peer mid-program is not a flake)
+    elastic_grow: str = "off"       # off | on (requires elastic="on"):
+                                    # scale back UP. The shrunk survivor
+                                    # polls a filesystem rendezvous board
+                                    # (<checkpoint_dir>/elastic_board) for
+                                    # returned hosts, admits the debounced
+                                    # set at a poll boundary (mesh epoch
+                                    # +1), writes a boundary save both
+                                    # sides restore, and re-forms the wider
+                                    # world (docs/resilience.md "Elastic
+                                    # scale-up"). ZERO-COST off: compiled
+                                    # step byte-identical
+                                    # (hlo-elastic-grow-off-identity)
+    elastic_dwell_steps: int = 2    # elastic_grow="on": minimum steps the
+                                    # current mesh epoch must dwell before
+                                    # the next grow re-mesh — remesh-rate
+                                    # hysteresis so flapping hosts cannot
+                                    # thrash shrink/grow cycles
+    elastic_grow_debounce: int = 2  # elastic_grow="on": consecutive polls
+                                    # a rejoin candidate must stay FRESH on
+                                    # the board (announce seq advancing)
+                                    # before admission — a host that flaps
+                                    # away mid-courtship is dropped, not
+                                    # admitted
+    elastic_policy: str = "fixed"   # fixed | score: mesh-shape policy on a
+                                    # membership change (resilience/
+                                    # fleet.py). fixed preserves
+                                    # model_axis_size (TP width) and gives
+                                    # the data axis every device; score
+                                    # ranks candidate (data, model) splits
+                                    # by the comm_model wire-byte model +
+                                    # compiled-HLO cost analysis
     # --- block-scaled int8 data plane (ops/quant.py; docs/SCALING.md
     # "Quantized data plane"). Both off by default and ZERO-COST off: the
     # compiled train step and the serve/refill paths are byte-identical to
@@ -723,6 +763,37 @@ class CrossCoderConfig:
                     "elastic='on' cannot run with seq_shards > 1: the "
                     "sequence-parallel harvest pins the mesh data axis to "
                     "seq_shards, which a survivor re-mesh cannot preserve"
+                )
+            if self.elastic_suspect_probes < 1:
+                raise ValueError(
+                    f"elastic_suspect_probes must be >= 1, got "
+                    f"{self.elastic_suspect_probes} (1 = declare on the "
+                    f"first failed probe, no hysteresis)"
+                )
+        _check_choice("elastic_grow", self.elastic_grow, ("off", "on"))
+        _check_choice("elastic_policy", self.elastic_policy,
+                      ("fixed", "score"))
+        if self.elastic_grow == "on":
+            if self.elastic != "on":
+                raise ValueError(
+                    "elastic_grow='on' requires elastic='on': scale-up "
+                    "re-forms the world the elastic membership layer owns"
+                )
+            if not self.checkpoint_dir:
+                raise ValueError(
+                    "elastic_grow='on' requires checkpoint_dir: the rejoin "
+                    "rendezvous board and the admission boundary save both "
+                    "live under it (joiners hydrate from that save)"
+                )
+            if self.elastic_dwell_steps < 0:
+                raise ValueError(
+                    f"elastic_dwell_steps must be >= 0, got "
+                    f"{self.elastic_dwell_steps}"
+                )
+            if self.elastic_grow_debounce < 1:
+                raise ValueError(
+                    f"elastic_grow_debounce must be >= 1, got "
+                    f"{self.elastic_grow_debounce}"
                 )
         if self.quant_block < 1:
             raise ValueError(

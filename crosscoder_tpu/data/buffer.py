@@ -910,12 +910,19 @@ class PairedActivationBuffer:
     def prepare_reshard(self) -> None:
         """Quiesce in-flight refill work and park every device-resident
         piece this buffer OWNS (the LM parameters) to host memory, ahead
-        of a backend teardown — the elastic shrink invalidates all live
-        device buffers. Must run BEFORE ``multihost.shrink_to_local()``;
-        :meth:`reshard` rebuilds the device side on the new mesh. The
-        store itself is NOT parked: it re-fills from the provenance
-        stream, which is the existing save/restore contract and cheaper
-        than dragging the multi-GB store through host RAM."""
+        of a backend teardown — an elastic shrink OR grow invalidates all
+        live device buffers either way. Must run BEFORE
+        ``multihost.shrink_to_local()`` / ``multihost.grow_to()``;
+        :meth:`reshard` rebuilds the device side on the new mesh. Both
+        calls are direction-agnostic and re-entrant per cycle, so a full
+        grow/shrink/grow sequence is just the pair applied once per
+        membership change (``reshard`` re-materializes the parked params
+        with ``jnp.asarray``, which a later ``prepare_reshard`` parks
+        again). The store itself is NOT parked: it re-fills from the
+        provenance stream, which is the existing save/restore contract
+        and cheaper than dragging the multi-GB store through host RAM —
+        and it is what makes the post-cycle batch stream deterministic:
+        the stream position, not the store bytes, is the state."""
         try:
             self._quiesce_dispatch()
         except Exception as e:

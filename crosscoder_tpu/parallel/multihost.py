@@ -189,6 +189,64 @@ class _ElasticState:
 _elastic = _ElasticState()
 
 
+def _build_elastic_runtime(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    heartbeat_s: float,
+) -> None:
+    """Build the survivable coordination service/client and wire it into
+    ``jax._src.distributed.global_state`` — the shared plumbing under
+    :func:`elastic_initialize` (gang start, epoch 0) and :func:`grow_to`
+    (re-formation at a later epoch). Process 0 hosts the service.
+
+    NOTE the runtime's own heartbeat-death propagation is UNUSABLE here:
+    when the service declares a task dead, the error-polling agent
+    delivers the status through the missed-heartbeat callback wrapper,
+    whose status cast aborts the process (``std::bad_cast``) on this
+    jaxlib — aborting exactly the process that must survive, in a race
+    with the shrink. The controller's bounded probe barriers (and
+    torn-collective confirmation) are therefore the ONLY detection path,
+    and the heartbeat window is pushed far past any plausible
+    detect-and-remesh time so the propagation can never fire first:
+    probes declare loss within ``suspect_probes * grace_s`` (seconds);
+    the service would need ``beat * _HEARTBEAT_SLACK`` (minutes), by
+    which time shrink/grow has already torn this world down. The python
+    callback stays wired as a last-resort flag only.
+    """
+    from jax._src import distributed
+    from jax._src.lib import xla_extension
+
+    gs = distributed.global_state
+    beat = max(1, round(heartbeat_s))
+    _HEARTBEAT_SLACK = 600      # beats until the service declares death
+
+    def _on_missed_heartbeat(status) -> None:
+        # a peer stopped heartbeating: record it for the controller's next
+        # poll instead of the default LOG(FATAL) process termination
+        print(f"[crosscoder_tpu] elastic: peer heartbeat lost ({status})",
+              flush=True, file=sys.stderr)
+        _elastic.peer_lost.set()
+
+    port = coordinator_address.rsplit(":", 1)[1]
+    if process_id == 0:
+        gs.service = xla_extension.get_distributed_runtime_service(
+            f"[::]:{port}", num_processes,
+            heartbeat_interval=beat,
+            max_missing_heartbeats=_HEARTBEAT_SLACK,
+        )
+    gs.client = xla_extension.get_distributed_runtime_client(
+        coordinator_address, process_id, init_timeout=60,
+        heartbeat_interval=beat, max_missing_heartbeats=_HEARTBEAT_SLACK,
+        missed_heartbeat_callback=_on_missed_heartbeat,
+        shutdown_on_destruction=False, use_compression=True,
+    )
+    gs.client.connect()
+    gs.process_id = process_id
+    gs.num_processes = num_processes
+    gs.coordinator_address = coordinator_address
+
+
 def elastic_initialize(
     coordinator_address: str,
     num_processes: int,
@@ -204,37 +262,13 @@ def elastic_initialize(
     slices put the service on the most protected host).
     """
     from jax._src import distributed
-    from jax._src.lib import xla_extension
 
-    gs = distributed.global_state
-    if gs.client is not None:
+    if distributed.global_state.client is not None:
         raise RuntimeError("distributed runtime already initialized")
     _enable_cpu_collectives()
-    beat = max(1, round(heartbeat_s))
-
-    def _on_missed_heartbeat(status) -> None:
-        # a peer stopped heartbeating: record it for the controller's next
-        # poll instead of the default LOG(FATAL) process termination
-        print(f"[crosscoder_tpu] elastic: peer heartbeat lost ({status})",
-              flush=True, file=sys.stderr)
-        _elastic.peer_lost.set()
-
-    port = coordinator_address.rsplit(":", 1)[1]
-    if process_id == 0:
-        gs.service = xla_extension.get_distributed_runtime_service(
-            f"[::]:{port}", num_processes,
-            heartbeat_interval=beat, max_missing_heartbeats=3,
-        )
-    gs.client = xla_extension.get_distributed_runtime_client(
-        coordinator_address, process_id, init_timeout=60,
-        heartbeat_interval=beat, max_missing_heartbeats=3,
-        missed_heartbeat_callback=_on_missed_heartbeat,
-        shutdown_on_destruction=False, use_compression=True,
+    _build_elastic_runtime(
+        coordinator_address, num_processes, process_id, heartbeat_s
     )
-    gs.client.connect()
-    gs.process_id = process_id
-    gs.num_processes = num_processes
-    gs.coordinator_address = coordinator_address
     _elastic.peer_lost.clear()
     _elastic.membership = Membership(
         epoch=0, num_processes=num_processes, process_id=process_id,
@@ -249,10 +283,22 @@ def membership() -> Membership | None:
 
 
 def peer_loss_flagged() -> bool:
-    """True once the coordination heartbeat has reported a dead peer
-    (asynchronous — the flag may trail the actual death by up to
-    ~3 heartbeat intervals)."""
+    """True once a failed liveness barrier (or, last-resort, the
+    coordination heartbeat) has recorded a dead peer. Heartbeat-side
+    detection is deliberately near-disabled — see
+    :func:`_build_elastic_runtime` — so in practice the flag latches at
+    the first timed-out barrier."""
     return _elastic.peer_lost.is_set()
+
+
+def clear_peer_loss() -> None:
+    """Clear the asynchronous peer-loss flag after the controller ABSORBS
+    a failed probe (hysteresis: a flaky/slow host below the
+    ``elastic_suspect_probes`` threshold gets another probe before anyone
+    declares it dead; a latched flag would short-circuit every later
+    probe to False and defeat the absorption). Never needed once loss is
+    declared — shrink/grow reset the flag themselves."""
+    _elastic.peer_lost.clear()
 
 
 def probe_liveness(seq: int, timeout_s: float) -> bool:
@@ -330,5 +376,69 @@ def shrink_to_local() -> Membership:
     _elastic.membership = Membership(
         epoch=old.epoch + 1, num_processes=1, process_id=0,
         coordinator_address=None,
+    )
+    return _elastic.membership
+
+
+def grow_to(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    epoch: int,
+    heartbeat_s: float = 1.0,
+) -> Membership:
+    """Re-form a WIDER world: build a fresh coordination service/client
+    (new port — the old world's service died with the shrink) and reset
+    the backend so the next jax computation spans every member's devices.
+
+    Two caller shapes share this entry point:
+
+    - the shrunk survivor (``process_id == 0``): has a live
+      single-process backend; the reset INVALIDATES every device buffer,
+      so callers must have quiesced in-flight work and must rebuild all
+      device state from host/disk (the elastic controller restores the
+      admission boundary save);
+    - a freshly returned joiner (``process_id > 0``): must call this
+      BEFORE its first jax computation, exactly like
+      :func:`elastic_initialize` (clearing the not-yet-created backend is
+      a no-op there).
+
+    ``epoch`` is the admitted mesh epoch and must be monotone: the
+    survivor passes its post-shrink epoch + 1; joiners adopt the epoch of
+    their admit record. Liveness keys embed it, so no barrier of the
+    grown world can collide with any earlier membership's. The actual
+    device-topology rendezvous happens lazily at backend creation (the
+    first jax computation blocks until all ``num_processes`` have
+    connected and published their local devices).
+    """
+    from jax._src import distributed
+
+    if distributed.global_state.client is not None:
+        raise RuntimeError(
+            "grow_to with a live distributed runtime; shrink_to_local first"
+        )
+    if num_processes < 2:
+        raise ValueError(f"grow_to needs a multi-process target world, "
+                         f"got num_processes={num_processes}")
+    old = _elastic.membership
+    if old is not None and epoch <= old.epoch:
+        raise ValueError(
+            f"grow_to epoch {epoch} is not past the current epoch "
+            f"{old.epoch}: mesh epochs are monotone"
+        )
+    # the shrink parked the CPU collectives impl at "none"; the grown
+    # multi-process backend needs gloo again (set BEFORE backend creation)
+    _enable_cpu_collectives()
+    _build_elastic_runtime(
+        coordinator_address, num_processes, process_id, heartbeat_s
+    )
+    jax.clear_caches()
+    from jax.extend import backend as jax_backend
+
+    jax_backend.clear_backends()
+    _elastic.peer_lost.clear()
+    _elastic.membership = Membership(
+        epoch=epoch, num_processes=num_processes, process_id=process_id,
+        coordinator_address=coordinator_address,
     )
     return _elastic.membership

@@ -41,12 +41,32 @@ Grammar (``N`` = event index, ``SEC`` = float seconds):
   error on the survivors, which the elastic controller confirms via
   the same membership barrier
 - ``fail@N``                — raise ChaosFault at serve N
+- ``return@N``              — a previously killed host RETURNS: at serve
+  N the surviving coordinator opens the rejoin window (posts the grant
+  token the drill's parked rejoiner waits for) — the scale-UP half of
+  the elastic fault model (cfg.elastic_grow, docs/resilience.md
+  "Elastic scale-up"); inert without an elastic controller
+- ``flaky@N:P``             — intermittent missed heartbeats: from
+  liveness-probe index N onward this host SKIPS each probe barrier with
+  probability P (seeded per probe index, so the miss pattern is
+  run-to-run identical). NOT fire-once — flakiness is a property, not
+  an event. Must exercise the controller's hysteresis
+  (``elastic_suspect_probes``), never a remesh on its own
+- ``slow@N:MS``             — delayed collective participation: join
+  probe N's barrier MS milliseconds late (heartbeat < MS < grace models
+  a straggler the peers must tolerate, counting
+  ``elastic_slow_probes``)
 - ``stall-harvest@N[:SEC]`` — stall harvest chunk N
 - ``fail-harvest@N``        — raise ChaosFault at harvest chunk N
 - ``corrupt-save@V[:KIND]`` — corrupt save version V's artifact; KIND in
   ``weights`` (default) | ``state`` | ``cfg`` | ``meta``
 - ``mode=truncate|flipbyte`` — corruption mode (default truncate)
 - ``seed=N``                — seed for the deterministic flip offset
+  and the flaky@ miss pattern
+
+:meth:`Chaos.render` is the grammar's inverse: it emits a canonical
+spec string that re-parses to an equivalent plan (round-trip tested), so
+drills can log exactly which fault schedule they ran.
 """
 
 from __future__ import annotations
@@ -68,6 +88,10 @@ _ARTIFACTS = {
 
 _DEFAULT_STALL_S = 30.0
 
+# dedicated seed stream for the flaky@ probe-miss pattern, so it can never
+# collide with the corrupt-save flip-offset stream at the same seed
+_FLAKY_STREAM = 104729
+
 
 class ChaosFault(RuntimeError):
     """The exception an injected ``fail@``/``fail-harvest@`` fault raises."""
@@ -84,6 +108,9 @@ class Chaos:
         fail_serves: tuple[int, ...] = (),
         preempt_serves: tuple[int, ...] = (),
         die_serves: tuple[int, ...] = (),
+        return_serves: tuple[int, ...] = (),
+        flaky_probes: dict[int, float] | None = None,
+        slow_probes: dict[int, float] | None = None,
         stall_harvests: dict[int, float] | None = None,
         fail_harvests: tuple[int, ...] = (),
         corrupt_saves: dict[int, str] | None = None,
@@ -98,12 +125,25 @@ class Chaos:
                     f"corrupt-save artifact kind must be one of "
                     f"{sorted(_ARTIFACTS)}, got {kind!r}"
                 )
+        for idx, p in (flaky_probes or {}).items():
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(
+                    f"flaky@{idx}: probability must be in [0, 1], got {p}"
+                )
+        for idx, ms in (slow_probes or {}).items():
+            if ms <= 0:
+                raise ValueError(
+                    f"slow@{idx}: delay must be > 0 ms, got {ms}"
+                )
         self.nan_serves = tuple(nan_serves)
         self.inf_serves = tuple(inf_serves)
         self.stall_serves = dict(stall_serves or {})
         self.fail_serves = tuple(fail_serves)
         self.preempt_serves = tuple(preempt_serves)
         self.die_serves = tuple(die_serves)
+        self.return_serves = tuple(return_serves)
+        self.flaky_probes = dict(flaky_probes or {})
+        self.slow_probes = dict(slow_probes or {})
         self.stall_harvests = dict(stall_harvests or {})
         self.fail_harvests = tuple(fail_harvests)
         self.corrupt_saves = dict(corrupt_saves or {})
@@ -124,6 +164,7 @@ class Chaos:
         kw: dict[str, Any] = {
             "nan_serves": [], "inf_serves": [], "stall_serves": {},
             "fail_serves": [], "preempt_serves": [], "die_serves": [],
+            "return_serves": [], "flaky_probes": {}, "slow_probes": {},
             "stall_harvests": {}, "fail_harvests": [],
             "corrupt_saves": {},
         }
@@ -154,6 +195,12 @@ class Chaos:
                 kw["preempt_serves"].append(idx)
             elif kind == "die":
                 kw["die_serves"].append(idx)
+            elif kind == "return":
+                kw["return_serves"].append(idx)
+            elif kind == "flaky":
+                kw["flaky_probes"][idx] = float(extra) if extra else 0.5
+            elif kind == "slow":
+                kw["slow_probes"][idx] = float(extra) if extra else 1000.0
             elif kind == "stall-harvest":
                 kw["stall_harvests"][idx] = float(extra) if extra else _DEFAULT_STALL_S
             elif kind == "fail-harvest":
@@ -167,8 +214,34 @@ class Chaos:
         kw["fail_serves"] = tuple(kw["fail_serves"])
         kw["preempt_serves"] = tuple(kw["preempt_serves"])
         kw["die_serves"] = tuple(kw["die_serves"])
+        kw["return_serves"] = tuple(kw["return_serves"])
         kw["fail_harvests"] = tuple(kw["fail_harvests"])
         return cls(**kw)
+
+    def render(self) -> str:
+        """The grammar's inverse: a canonical spec string such that
+        ``Chaos.parse(c.render())`` plans the identical fault schedule
+        (round-trip tested in tests/test_elastic.py)."""
+        toks: list[str] = []
+        for label, idxs in (("nan", self.nan_serves), ("inf", self.inf_serves),
+                            ("fail", self.fail_serves),
+                            ("preempt", self.preempt_serves),
+                            ("die", self.die_serves),
+                            ("return", self.return_serves),
+                            ("fail-harvest", self.fail_harvests)):
+            toks.extend(f"{label}@{i}" for i in sorted(idxs))
+        for label, table in (("stall", self.stall_serves),
+                             ("flaky", self.flaky_probes),
+                             ("slow", self.slow_probes),
+                             ("stall-harvest", self.stall_harvests)):
+            toks.extend(f"{label}@{i}:{v:g}" for i, v in sorted(table.items()))
+        toks.extend(f"corrupt-save@{v}:{kind}"
+                    for v, kind in sorted(self.corrupt_saves.items()))
+        if self.corrupt_mode != "truncate":
+            toks.append(f"mode={self.corrupt_mode}")
+        if self.seed:
+            toks.append(f"seed={self.seed}")
+        return ",".join(toks)
 
     @classmethod
     def from_cfg_env(cls, cfg) -> "Chaos | None":
@@ -215,6 +288,36 @@ class Chaos:
                   f"{serve}", flush=True, file=sys.stderr)
             sys.stderr.flush()
             os._exit(43)
+
+    def take_return(self, serve: int) -> bool:
+        """True exactly once when a ``return@serve`` grant is planned: the
+        fleet hands capacity back at this serve, and the caller (the
+        trainer, on the surviving coordinator) opens the rejoin window on
+        the elastic controller's rendezvous board."""
+        return serve in self.return_serves and self._fire("return", serve)
+
+    # --- probe-path hooks (elastic liveness barriers) -------------------
+    def on_probe(self, probe: int) -> str | float | None:
+        """Behavior of liveness-probe index ``probe`` on THIS host:
+
+        - ``"skip"`` — flaky: miss the probe barrier entirely (the peers
+          time out and count a suspect; the controller sits out the same
+          grace window so the probe phases stay aligned);
+        - a float — slow: join the barrier that many SECONDS late;
+        - ``None`` — healthy.
+
+        Slow faults are fire-once events; flaky is a persistent property
+        from its start index, with a per-probe seeded coin so the miss
+        pattern is deterministic and precomputable by drills."""
+        if probe in self.slow_probes and self._fire("slow_probe", probe):
+            return self.slow_probes[probe] / 1000.0
+        starts = [s for s in self.flaky_probes if s <= probe]
+        if starts:
+            p = self.flaky_probes[max(starts)]
+            if p > 0 and np.random.default_rng(
+                    (self.seed, _FLAKY_STREAM, probe)).random() < p:
+                return "skip"
+        return None
 
     def poison_batch(self, batch: Any, serve: int) -> Any:
         """Overwrite row 0 of serve ``serve``'s batch with NaN/Inf."""

@@ -32,15 +32,49 @@ symmetric survivor election needs an external membership service, and the
 TPU-fleet preemption story (PAPERS.md, arXiv:2605.25645) preempts workers
 far more often than the protected coordinator.
 
+**Scale-UP** (``cfg.elastic_grow``; docs/resilience.md "Elastic
+scale-up") closes the other half of the elasticity story — without it a
+preemptible run decays monotonically toward one host:
+
+- **Hysteresis before anything else**: a liveness probe miss below
+  ``cfg.elastic_suspect_probes`` consecutive failures is ABSORBED
+  (``resilience/elastic_suspects``), so flaky heartbeats (chaos
+  ``flaky@S:p``) and stragglers (``slow@S:ms``) cost grace windows, not
+  remeshes. Only a run of misses — or a torn collective, which is never
+  a flake — declares loss.
+- **Rejoin rendezvous** rides a filesystem board
+  (``<checkpoint_dir>/elastic_board``): returned hosts post freshness-
+  stamped announces, the shrunk survivor polls at the probe cadence and
+  admits candidates only after observing their announce seq advance
+  ``cfg.elastic_grow_debounce`` times, after at least
+  ``cfg.elastic_dwell_steps`` steps in the current epoch (flap damping
+  on both axes).
+- **Admission is a boundary save**: the survivor quiesces, checkpoints
+  (state + stream snapshot), posts an admit record naming that save plus
+  the fresh coordinator address and process assignments, and calls
+  :func:`multihost.grow_to`. Joiners hydrate by restoring the exact same
+  save — zero lost steps, no survivor-side rewind, no fleet-wide
+  restart — which is also what makes the post-grow trajectory
+  bitwise-comparable to a clean start at the wide shape.
+- **Mesh shape** comes from :class:`crosscoder_tpu.resilience.fleet
+  .FleetPolicy` — fixed TP width by default, wire-byte + HLO-cost scored
+  under ``cfg.elastic_policy="score"``.
+
 Zero-cost off: with ``cfg.elastic="off"`` (default) no controller object
 exists, the train loop carries only is-None checks, and the compiled step
-HLO is byte-identical (contracts rule ``hlo-elastic-off-identity``).
+HLO is byte-identical (contracts rule ``hlo-elastic-off-identity``; the
+grow plane has its own rule ``hlo-elastic-grow-off-identity``).
 """
 
 from __future__ import annotations
 
+import contextlib
+import json
+import os
+import socket
 import sys
 import time
+from pathlib import Path
 
 import jax
 
@@ -52,6 +86,148 @@ class PeerLoss(RuntimeError):
     """Raised into the train loop when membership confirms a dead peer."""
 
 
+class GrowAborted(RuntimeError):
+    """A grow admission that could not complete (candidates vanished
+    between debounce and rendezvous); the survivor falls back to its
+    narrow world and keeps training."""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class RendezvousBoard:
+    """Filesystem rendezvous for returned hosts (cfg.elastic_grow).
+
+    The old world's coordination service died with the shrink, so a
+    returned host has nothing to announce itself to — the board is the
+    out-of-band channel: a directory under the run's ``checkpoint_dir``
+    (shared storage on a real fleet) where candidates post announces and
+    the surviving coordinator posts the admit record. All writes are
+    atomic (tmp + rename), so readers never observe torn JSON.
+
+    Freshness is SEQUENCE-based, not wall-clock: a candidate rewrites its
+    announce with a monotonically increasing ``seq`` every beat, and the
+    coordinator counts it fresh on a poll iff the seq advanced since the
+    previous poll — no clock synchronization between hosts, and a
+    crashed candidate goes stale within one poll.
+    """
+
+    def __init__(self, root: str | os.PathLike) -> None:
+        self.root = Path(root)
+
+    def _write_json(self, path: Path, payload: dict) -> None:
+        self.root.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(f".tmp-{os.getpid()}")
+        tmp.write_text(json.dumps(payload))
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _read_json(path: Path) -> dict | None:
+        try:
+            return json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None     # mid-replace or gone: treat as absent
+
+    # -- capacity grant (the return@S chaos token lands here) -----------
+
+    def post_grant(self, payload: dict) -> None:
+        """The fleet granted capacity back: open the rejoin window. The
+        drill's parked rejoiner waits on this before announcing; real
+        returned hosts announce directly and never read it."""
+        self._write_json(self.root / "grant.json", payload)
+
+    def read_grant(self) -> dict | None:
+        return self._read_json(self.root / "grant.json")
+
+    # -- candidate side -------------------------------------------------
+
+    def announce(self, candidate_id: str, devices: int, seq: int) -> None:
+        self._write_json(self.root / f"join_{candidate_id}.json", {
+            "id": candidate_id, "devices": int(devices), "seq": int(seq),
+        })
+
+    def retract(self, candidate_id: str) -> None:
+        with contextlib.suppress(OSError):
+            (self.root / f"join_{candidate_id}.json").unlink()
+
+    def read_admit(self) -> dict | None:
+        """The newest admit record (by epoch), or None."""
+        best = None
+        for p in self.root.glob("admit_*.json"):
+            rec = self._read_json(p)
+            if rec and (best is None or rec["epoch"] > best["epoch"]):
+                best = rec
+        return best
+
+    def announce_until_admitted(
+        self, candidate_id: str, devices: int, timeout_s: float,
+        beat_s: float = 0.25,
+    ) -> dict:
+        """Candidate courtship: post freshness beats until an admit record
+        naming this candidate appears; returns that record. The announce
+        is retracted either way (admission consumed it; timeout means the
+        candidate gives up cleanly instead of haunting the board)."""
+        deadline = time.monotonic() + timeout_s
+        seq = 0
+        try:
+            while time.monotonic() < deadline:
+                self.announce(candidate_id, devices, seq)
+                seq += 1
+                admit = self.read_admit()
+                if admit and candidate_id in admit.get("assignments", {}):
+                    return admit
+                time.sleep(beat_s)
+        finally:
+            self.retract(candidate_id)
+        raise TimeoutError(
+            f"rejoin candidate {candidate_id} was not admitted within "
+            f"{timeout_s:.0f}s"
+        )
+
+    # -- coordinator side -----------------------------------------------
+
+    def poll_announces(self) -> list[dict]:
+        return [rec for p in sorted(self.root.glob("join_*.json"))
+                if (rec := self._read_json(p)) is not None]
+
+    def post_admit(self, record: dict) -> None:
+        self._write_json(self.root / f"admit_{record['epoch']}.json", record)
+
+    def clear_admit(self, epoch: int) -> None:
+        with contextlib.suppress(OSError):
+            (self.root / f"admit_{epoch}.json").unlink()
+
+
+def join_grown_world(admit: dict, candidate_id: str,
+                     heartbeat_s: float = 1.0,
+                     barrier_timeout_s: float = 30.0):
+    """Joiner-side rendezvous: enter the world an admit record describes.
+
+    Must run BEFORE the process's first jax computation. Returns the
+    grown world's mesh (built from the admit record's shape, so every
+    member lays the same axes over the same device order). The caller
+    then builds its trainer on that mesh and restores the admit record's
+    boundary save — the hydration path that replaces a fleet-wide
+    restart.
+    """
+    pid = int(admit["assignments"][candidate_id])
+    m = multihost.grow_to(
+        admit["coordinator_address"], int(admit["num_processes"]), pid,
+        epoch=int(admit["epoch"]), heartbeat_s=heartbeat_s,
+    )
+    if not multihost.probe_liveness(f"g{m.epoch}",
+                                    timeout_s=barrier_timeout_s):
+        raise GrowAborted(
+            f"admission barrier of epoch {m.epoch} failed on joiner {pid}"
+        )
+    return mesh_lib.make_mesh(int(admit["n_data"]), int(admit["n_model"]))
+
+
 class ElasticController:
     """Liveness probing + survivor re-mesh for one training run.
 
@@ -61,12 +237,39 @@ class ElasticController:
     survivor world is rebuilt.
     """
 
-    def __init__(self, cfg, counters=None) -> None:
+    def __init__(self, cfg, counters=None, chaos=None) -> None:
         self.cfg = cfg
         self.counters = counters
+        self._chaos = chaos     # probe-path fault injection (flaky/slow)
         self._confirm_seq = 0   # exception-time probes, SPMD-consistent
                                 # (every process reaches the same failure
                                 # point and has run the same count)
+        self._probe_count = 0   # monotone probe index (chaos keys)
+        self._suspect = 0       # consecutive failed probes (hysteresis)
+        self._last_remesh_step: int | None = None
+        # -- scale-up state (cfg.elastic_grow; None-guarded when off) ----
+        self._board = None
+        self._policy = None
+        self._stable_candidates: list[dict] = []
+        # id -> (seq, observed-advance streak, local time of last advance)
+        self._cand_freshness: dict[str, tuple[int, int, float]] = {}
+        if getattr(cfg, "elastic_grow", "off") == "on":
+            from crosscoder_tpu.resilience.fleet import FleetPolicy
+
+            self._board = RendezvousBoard(
+                Path(cfg.checkpoint_dir) / "elastic_board"
+            )
+            self._policy = FleetPolicy(cfg)
+        # the original coordinator HOST: a grown world re-forms on it with
+        # a fresh port (the shrunk membership no longer records an address)
+        m = multihost.membership()
+        self._coordinator_host = "localhost"
+        if m is not None and m.coordinator_address:
+            self._coordinator_host = m.coordinator_address.rsplit(":", 1)[0]
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        if self.counters is not None:
+            self.counters.bump(key, n)
 
     # -- liveness ------------------------------------------------------
 
@@ -84,12 +287,50 @@ class ElasticController:
         return self.active() and step % int(self.cfg.stop_poll_every) == 0
 
     def probe(self, step: int) -> bool:
-        """True when all peers are alive; False declares peer loss."""
-        if self.counters is not None:
-            self.counters.bump("elastic_probes")
-        return multihost.probe_liveness(
+        """True when all peers are alive; False DECLARES peer loss.
+
+        Hysteresis: a single failed barrier is a SUSPICION, not a death —
+        flaky heartbeats and stragglers must cost grace windows, not
+        remeshes. Only ``cfg.elastic_suspect_probes`` consecutive misses
+        declare loss; any success resets the count (and clears the
+        asynchronous peer-lost flag a timed-out barrier latched, so the
+        next probe gets a fresh barrier instead of a short-circuit).
+
+        Chaos (tests/drills only): ``flaky@S:p`` makes THIS host skip the
+        barrier — it sits out the same grace window its peers spend
+        timing out, so the step-indexed probe phases stay aligned;
+        ``slow@S:ms`` joins late. Peers count a slow-but-successful probe
+        (wall time past the heartbeat) in ``elastic_slow_probes``.
+        """
+        self._bump("elastic_probes")
+        behavior = None
+        if self._chaos is not None:
+            behavior = self._chaos.on_probe(self._probe_count)
+        self._probe_count += 1
+        if behavior == "skip":
+            self._bump("elastic_skipped_probes")
+            time.sleep(self.cfg.elastic_grace_s)
+            return True
+        if isinstance(behavior, float):
+            time.sleep(behavior)
+        t0 = time.perf_counter()
+        ok = multihost.probe_liveness(
             f"p{step}", timeout_s=self.cfg.elastic_grace_s
         )
+        if ok:
+            if time.perf_counter() - t0 > self.cfg.elastic_heartbeat_s:
+                self._bump("elastic_slow_probes")
+            self._suspect = 0
+            return True
+        self._suspect += 1
+        self._bump("elastic_suspects")
+        if self._suspect >= int(self.cfg.elastic_suspect_probes):
+            return False
+        print(f"[crosscoder_tpu] elastic: probe p{step} missed "
+              f"({self._suspect}/{self.cfg.elastic_suspect_probes} before "
+              f"loss is declared)", flush=True, file=sys.stderr)
+        multihost.clear_peer_loss()
+        return True
 
     def confirm_peer_loss(self, exc: BaseException) -> bool:
         """An exception escaped the step/serve path: was it a dying peer
@@ -151,3 +392,152 @@ class ElasticController:
                 f"model_axis_size={model}; cannot re-mesh"
             )
         return mesh_lib.make_mesh(n // model, model)
+
+    # -- scale-up (cfg.elastic_grow; docs/resilience.md "Elastic
+    # scale-up") --------------------------------------------------------
+
+    def note_remesh(self, step: int) -> None:
+        """Anchor the dwell clock: the trainer reports the step each
+        shrink/grow resumed at, and ``grow_ready`` refuses another remesh
+        within ``cfg.elastic_dwell_steps`` of it (flap damping)."""
+        self._last_remesh_step = int(step)
+        self._cand_freshness.clear()
+        self._stable_candidates = []
+
+    def open_rejoin_window(self, serve: int) -> None:
+        """The chaos ``return@S`` token lands here: model the fleet
+        granting capacity back at serve ``serve`` by posting the grant
+        token the drill's parked rejoiner waits for. Inert (None board)
+        unless ``cfg.elastic_grow="on"``."""
+        if self._board is not None:
+            self._board.post_grant({"serve": int(serve)})
+
+    def grow_ready(self, step: int) -> bool:
+        """One poll of the rejoin board (coordinator side, poll cadence).
+
+        True when a debounced candidate set is waiting AND the dwell has
+        elapsed — the trainer then quiesces, writes the boundary save,
+        and calls :meth:`grow`. Scale-up re-forms from the shrunk
+        single-process survivor world only (the membership layer's worlds
+        are {N, 1}: shrink goes all the way to local, grow re-forms from
+        there), so wider worlds return False without touching the board.
+        """
+        if self._board is None:
+            return False
+        m = multihost.membership()
+        if m is None or m.num_processes != 1 or m.process_id != 0:
+            return False
+        if step % int(self.cfg.stop_poll_every) != 0:
+            return False
+        if (self._last_remesh_step is not None
+                and step - self._last_remesh_step
+                < int(self.cfg.elastic_dwell_steps)):
+            return False
+        self._stable_candidates = self._poll_candidates()
+        return bool(self._stable_candidates)
+
+    def _poll_candidates(self) -> list[dict]:
+        """Freshness-debounced announce polling: a candidate counts
+        toward admission only after the coordinator has OBSERVED its
+        announce seq advance ``cfg.elastic_grow_debounce`` times (first
+        sighting counts as one). Counting observed ADVANCES — not polls —
+        keeps the debounce meaningful at any poll-rate-to-beat-rate
+        ratio: a coordinator polling every 20 ms step must not read a
+        candidate beating every 250 ms as stalled. Staleness is judged
+        against the coordinator's OWN monotonic clock (still no cross-
+        host clock sync): a seq that hasn't advanced within one grace
+        window means the candidate crashed mid-courtship, and its streak
+        restarts from scratch."""
+        now = time.monotonic()
+        fresh: dict[str, tuple[int, int, float]] = {}
+        stable: list[dict] = []
+        for rec in self._board.poll_announces():
+            cid, seq = rec["id"], int(rec["seq"])
+            last = self._cand_freshness.get(cid)
+            if last is None:
+                entry = (seq, 1, now)
+            elif seq > last[0]:
+                entry = (seq, last[1] + 1, now)
+            elif now - last[2] > float(self.cfg.elastic_grace_s):
+                entry = (seq, 0, last[2])    # gone stale: restart courtship
+            else:
+                entry = last                 # between beats: streak holds
+            fresh[cid] = entry
+            if entry[1] >= int(self.cfg.elastic_grow_debounce):
+                stable.append(rec)
+        self._cand_freshness = fresh     # vanished candidates drop out
+        return stable
+
+    def grow(self, step: int, save_version: int, version_dir: str,
+             save_step: int):
+        """Admit the debounced candidates and re-form the wider world.
+
+        The caller (trainer) has already quiesced and written boundary
+        save ``save_version`` at ``save_step``; the admit record names it
+        and EVERY member — survivor included — restores exactly that
+        save, so the grown world's trajectory is bitwise-identical to a
+        clean start at the wide shape from the same checkpoint (no
+        survivor-broadcast of live state: the save plus the stream
+        snapshot inside it IS the broadcast, via shared storage).
+
+        Returns ``(mesh, admit_record)``. If the rendezvous fails — the
+        candidates vanished between debounce and connection — the world
+        is torn back down to single-process (epochs stay monotone: the
+        failed epoch is burned) and ``(survivor_mesh, None)`` is
+        returned: the run continues narrow rather than dying.
+        """
+        m = multihost.membership()
+        if m is None or m.num_processes != 1:
+            raise GrowAborted("grow without a shrunk single-process world")
+        stable = self._stable_candidates
+        if not stable:
+            raise GrowAborted("grow without a debounced candidate set")
+        epoch = m.epoch + 1
+        choice = self._policy.choose(
+            jax.device_count() + sum(int(c["devices"]) for c in stable)
+        )
+        addr = f"{self._coordinator_host}:{_free_port()}"
+        admit = {
+            "epoch": epoch,
+            "coordinator_address": addr,
+            "num_processes": 1 + len(stable),
+            "assignments": {c["id"]: pid
+                            for pid, c in enumerate(stable, start=1)},
+            "save": int(save_version),
+            "step": int(save_step),
+            "version_dir": str(version_dir),
+            "n_data": choice.n_data,
+            "n_model": choice.n_model,
+        }
+        print(f"[crosscoder_tpu] elastic: admitting {len(stable)} "
+              f"candidate(s) at epoch {epoch} "
+              f"(mesh data {choice.n_data} × model {choice.n_model}, "
+              f"boundary save {save_version})", flush=True, file=sys.stderr)
+        self._board.post_admit(admit)
+        t0 = time.perf_counter()
+        try:
+            multihost.grow_to(addr, admit["num_processes"], 0, epoch,
+                              heartbeat_s=self.cfg.elastic_heartbeat_s)
+            if not multihost.probe_liveness(
+                    f"g{epoch}",
+                    timeout_s=max(30.0, 3 * self.cfg.elastic_grace_s)):
+                raise GrowAborted(
+                    f"admission barrier of epoch {epoch} failed"
+                )
+        except Exception as e:
+            self._bump("grow_aborts")
+            self._board.clear_admit(epoch)
+            print(f"[crosscoder_tpu] elastic: grow to epoch {epoch} "
+                  f"aborted ({type(e).__name__}: {e}); continuing narrow"
+                  [:400], flush=True, file=sys.stderr)
+            # burn the failed epoch and drop back to a single-process
+            # world (shrink_to_local handles a half-built client/service)
+            multihost.shrink_to_local()
+            return self.survivor_mesh(), None
+        self._bump("remeshes")
+        self._bump("grows")
+        print(f"[crosscoder_tpu] elastic: grew to epoch {epoch} "
+              f"({jax.device_count()} devices, "
+              f"{1000 * (time.perf_counter() - t0):.0f} ms world "
+              f"re-formation)", flush=True, file=sys.stderr)
+        return mesh_lib.make_mesh(choice.n_data, choice.n_model), admit

@@ -1,31 +1,45 @@
-"""Preemption drill: kill one host mid-run, watch the survivor re-mesh.
+"""Elasticity drills: preemption, full autoscale cycle, chaos stability.
 
-The executable proof of the elastic membership path (``cfg.elastic``;
-docs/resilience.md "Elastic membership", docs/RUNBOOK.md preemption drill):
+The executable proof of the elastic membership paths (``cfg.elastic`` /
+``cfg.elastic_grow``; docs/resilience.md, docs/RUNBOOK.md):
 
-- ``run_drill`` spawns TWO real processes over 4 virtual CPU devices each
-  (8-device ``data 2 × model 4`` mesh, gloo collectives), trains with
-  periodic saves, and has chaos kill process 1 abruptly (``die@N`` —
-  ``os._exit``, no notification) mid-run. Process 0 must detect the loss,
-  shrink to a single-process ``1 × 4`` world, restore-with-respec from the
-  newest verified save, and finish the run.
-- It then runs a third, CLEAN single-process child on the same ``1 × 4``
-  mesh restoring the exact save the survivor used. Determinism contract:
-  the survivor's post-remesh loss trajectory must be **bitwise equal** to
-  the clean restart's (same mesh ⇒ same HLO; same checkpoint ⇒ same state
-  and synthetic stream position — CPU float ops are run-to-run exact).
+- **preempt** (default): ``run_drill`` spawns TWO real processes over 4
+  virtual CPU devices each (8-device ``data 2 × model 4`` mesh, gloo
+  collectives), trains with periodic saves, and has chaos kill process 1
+  abruptly (``die@N`` — ``os._exit``, no notification) mid-run. Process 0
+  must detect the loss, shrink to a single-process ``1 × 4`` world,
+  restore-with-respec from the newest verified save, and finish the run.
+  A third, CLEAN single-process child then restores the exact save the
+  survivor used; the survivor's post-remesh loss trajectory must be
+  **bitwise equal** to the clean restart's.
+- **autoscale**: the full grow/shrink/grow cycle in ONE run. The pair
+  starts wide; ``die@S`` kills process 1 → the survivor shrinks and
+  replays; ``return@S`` then models the fleet granting capacity back (a
+  grant token on the rendezvous board) → a PARKED third child announces,
+  passes the debounce, and the survivor grows the world back to the wide
+  shape at a step boundary, hydrating the joiner from the admission
+  boundary save. Two determinism contracts close the drill: the
+  survivor's POST-GROW trajectory must be bitwise equal to a clean
+  2-process restart at the wide shape from the same save, and the
+  joiner's trajectory must be bitwise equal to the survivor's.
+- **stability**: probe-path chaos only — ``flaky@S:p`` (skipped
+  barriers) and ``slow@S:ms`` (a straggler), both BELOW the hysteresis
+  threshold. The run must complete with ZERO remeshes while the
+  resilience counters prove the faults actually fired (suspects absorbed
+  on the healthy host, skips/stalls taken on the chaotic one).
 
 The same module is the child entry point (``python -m
-crosscoder_tpu.resilience.elastic_drill --proc N ...``): children print a
-``{"ready": true}`` handshake line, then exactly one result JSON as the
-LAST stdout line. The parent helper is consumed by tests/test_elastic.py,
-the tier-1 preemption smoke (scripts/tier1.sh), and bench's ``elastic``
-leg (the drill's ``remesh_ms`` is the recovery-SLO headline).
+crosscoder_tpu.resilience.elastic_drill --proc N --mode M ...``):
+children print a ``{"ready": true}`` handshake line, then exactly one
+result JSON as the LAST stdout line. The parent helpers are consumed by
+tests/test_elastic.py, the tier-1 smokes (scripts/tier1.sh), and bench's
+``elastic`` leg (``remesh_ms`` / ``grow_ms`` are the recovery-SLO
+headlines).
 
-Synthetic-source by design: the drill exercises membership, re-mesh, and
+Synthetic-source by design: the drills exercise membership, re-mesh, and
 restore-with-respec; the mesh-sharded DATA plane's reshard determinism has
 its own single-process test (tests/test_elastic.py::test_buffer_reshard) —
-keeping the 2-process drill LM-free keeps it fast enough for tier-1.
+keeping the multi-process drills LM-free keeps them fast enough for tier-1.
 """
 
 from __future__ import annotations
@@ -37,11 +51,35 @@ import socket
 import subprocess
 import sys
 import tempfile
+import time
 from pathlib import Path
 
 # one serve per step on the synthetic source, so die@N kills at step N's
 # batch production — after the liveness probe, before the step collective
 _DRILL = dict(steps=10, save_every=3, die_serve=7)
+
+# the full autoscale cycle: die → shrink → return-grant → debounced rejoin
+# → grow, in one run. Serve-indexed chaos on the survivor: with the death
+# at serve 6 (≈ step 6) and the newest save at step 4, the post-shrink
+# replay passes the death point around serve 10, where ``return@10``
+# posts the grant; the stall window behind it throttles the survivor's
+# steps (0.4 s each) so the parked rejoiner's courtship — grant poll plus
+# announce beats — lands within the remaining step budget regardless of
+# how fast the host steps.
+_AUTOSCALE = dict(steps=20, save_every=4, die_serve=6, return_serve=10,
+                  dwell=2, debounce=2, stall_from=11, stall_to=17,
+                  stall_s=0.4)
+
+# hysteresis-only chaos, strictly below the loss threshold: seed=3 pins
+# the flaky stream to skips at probes 3 and 7 (never consecutive; the
+# straggler sits at probe 5), so with suspect_probes=3 the healthy host
+# absorbs every miss. tests/test_elastic.py::test_stability_chaos_plan
+# asserts the pinned stream so an rng change cannot silently turn this
+# drill flaky.
+_STABILITY = dict(steps=8, grace_s=2.5, suspect_probes=3,
+                  chaos="flaky@2:0.4,slow@5:1500,seed=3")
+
+_REJOIN_WAIT_S = 240.0   # parked rejoiner's patience for the grant token
 
 
 def _free_port() -> int:
@@ -66,6 +104,38 @@ def _drill_cfg(workdir: str, *, two_proc: bool, elastic: str, chaos: str = ""):
     )
 
 
+def _autoscale_cfg(workdir: str, *, chaos: str = ""):
+    from crosscoder_tpu.config import CrossCoderConfig
+
+    return CrossCoderConfig(
+        d_in=32, dict_size=64, n_models=2, batch_size=16,
+        num_tokens=16 * 400, enc_dtype="fp32",
+        data_axis_size=2, model_axis_size=4,
+        log_backend="null", checkpoint_dir=workdir, prefetch=False,
+        log_every=1, save_every=_AUTOSCALE["save_every"], stop_poll_every=1,
+        elastic="on", elastic_heartbeat_s=1.0, elastic_grace_s=3.0,
+        elastic_grow="on", elastic_dwell_steps=_AUTOSCALE["dwell"],
+        elastic_grow_debounce=_AUTOSCALE["debounce"],
+        chaos=chaos,
+    )
+
+
+def _stability_cfg(workdir: str, *, chaos: str = ""):
+    from crosscoder_tpu.config import CrossCoderConfig
+
+    return CrossCoderConfig(
+        d_in=32, dict_size=64, n_models=2, batch_size=16,
+        num_tokens=16 * 200, enc_dtype="fp32",
+        data_axis_size=2, model_axis_size=4,
+        log_backend="null", checkpoint_dir=workdir, prefetch=False,
+        log_every=1, save_every=50, stop_poll_every=1,
+        elastic="on", elastic_heartbeat_s=1.0,
+        elastic_grace_s=_STABILITY["grace_s"],
+        elastic_suspect_probes=_STABILITY["suspect_probes"],
+        chaos=chaos,
+    )
+
+
 class _LossTape:
     """Duck-typed MetricsLogger capturing (step, loss-bits) pairs."""
 
@@ -82,7 +152,19 @@ class _LossTape:
         pass
 
 
+def _autoscale_chaos(proc: int) -> str:
+    if proc != 0:
+        return f"die@{_AUTOSCALE['die_serve']}"
+    stalls = ",".join(
+        f"stall@{s}:{_AUTOSCALE['stall_s']}"
+        for s in range(_AUTOSCALE["stall_from"], _AUTOSCALE["stall_to"] + 1)
+    )
+    return f"return@{_AUTOSCALE['return_serve']},{stalls}"
+
+
 def _child(args: argparse.Namespace) -> dict:
+    if args.mode == "rejoin":
+        return _rejoin_child(args)
     import jax
 
     from crosscoder_tpu.checkpoint.ckpt import Checkpointer
@@ -98,11 +180,27 @@ def _child(args: argparse.Namespace) -> dict:
             heartbeat_s=1.0,
         )
         assert jax.device_count() == 8, jax.device_count()
-    cfg = _drill_cfg(
-        args.workdir, two_proc=two_proc,
-        elastic="on" if two_proc else "off",
-        chaos=f"die@{_DRILL['die_serve']}" if args.proc == 1 else "",
-    )
+    if args.mode == "autoscale":
+        steps = _AUTOSCALE["steps"]
+        cfg = _autoscale_cfg(args.workdir, chaos=_autoscale_chaos(args.proc))
+    elif args.mode == "clean":
+        # the autoscale drill's reference leg: a fresh wide pair restoring
+        # the exact boundary save the grown world hydrated from
+        steps = _AUTOSCALE["steps"]
+        cfg = _autoscale_cfg(args.workdir)
+    elif args.mode == "stability":
+        steps = _STABILITY["steps"]
+        cfg = _stability_cfg(
+            args.workdir,
+            chaos=_STABILITY["chaos"] if args.proc == 1 else "",
+        )
+    else:   # preempt
+        steps = _DRILL["steps"]
+        cfg = _drill_cfg(
+            args.workdir, two_proc=two_proc,
+            elastic="on" if two_proc else "off",
+            chaos=f"die@{_DRILL['die_serve']}" if args.proc == 1 else "",
+        )
     mesh = mesh_lib.mesh_from_cfg(cfg)
     tape = _LossTape()
     tr = Trainer(cfg, mesh=mesh, logger=tape,
@@ -111,22 +209,70 @@ def _child(args: argparse.Namespace) -> dict:
     print(  # contracts: allow(lint-no-stdout-print) — parent handshake
         json.dumps({"proc": args.proc, "ready": True}), flush=True)
     if args.restore_save >= 0:
-        # clean-restart leg: resume the exact world the survivor resumed
-        tr.restore(version_dir=os.path.join(args.workdir, "version_0"),
-                   save=args.restore_save)
-    tr.train(num_steps=_DRILL["steps"])
+        # clean-restart legs: resume the exact world the survivor resumed
+        rd = args.restore_dir or os.path.join(args.workdir, "version_0")
+        tr.restore(version_dir=rd, save=args.restore_save)
+    tr.train(num_steps=steps)
     tr.close()
     return {
         "proc": args.proc,
         "losses": tape.rows,
         "remesh": getattr(tr, "last_remesh", None),
+        "grow": getattr(tr, "last_grow", None),
+        "counters": tr.resilience.snapshot(),
+        "final_step": int(tr.state.step),
+    }
+
+
+def _rejoin_child(args: argparse.Namespace) -> dict:
+    """The returned host: park on the rendezvous board until the fleet
+    grants capacity back (the survivor's ``return@S`` chaos), then court
+    the coordinator (freshness-beaten announces), enter the grown world
+    the admit record describes, hydrate from its boundary save, and train
+    shoulder-to-shoulder with the survivor to the end of the run."""
+    import jax
+
+    from crosscoder_tpu.checkpoint.ckpt import Checkpointer
+    from crosscoder_tpu.parallel import multihost
+    from crosscoder_tpu.resilience import elastic
+    from crosscoder_tpu.resilience.chaos import Chaos
+    from crosscoder_tpu.train.trainer import Trainer
+
+    board = elastic.RendezvousBoard(Path(args.workdir) / "elastic_board")
+    print(  # contracts: allow(lint-no-stdout-print) — parent handshake
+        json.dumps({"proc": "rejoin", "ready": True}), flush=True)
+    deadline = time.monotonic() + _REJOIN_WAIT_S
+    while board.read_grant() is None:
+        if time.monotonic() > deadline:
+            raise TimeoutError("rejoin child never saw a capacity grant")
+        time.sleep(0.1)
+    admit = board.announce_until_admitted(
+        "rejoin0", devices=jax.device_count(), timeout_s=120.0, beat_s=0.1)
+    mesh = elastic.join_grown_world(admit, "rejoin0", heartbeat_s=1.0)
+    assert jax.device_count() == 8, jax.device_count()
+    cfg = _autoscale_cfg(args.workdir)
+    tape = _LossTape()
+    tr = Trainer(cfg, mesh=mesh, logger=tape,
+                 checkpointer=Checkpointer(args.workdir),
+                 chaos=Chaos.from_cfg_env(cfg))
+    tr.restore(version_dir=admit["version_dir"], save=int(admit["save"]))
+    # hydration barrier, mirroring the survivor's _grow_and_resume: train
+    # only once every member of the grown world has restored
+    multihost.probe_liveness(f"r{int(admit['epoch'])}", timeout_s=120.0)
+    tr.train(num_steps=_AUTOSCALE["steps"])
+    tr.close()
+    return {
+        "proc": "rejoin",
+        "losses": tape.rows,
+        "admit": admit,
         "counters": tr.resilience.snapshot(),
         "final_step": int(tr.state.step),
     }
 
 
 def _spawn(workdir: str, proc: int, port: int, restore_save: int = -1,
-           stderr_path: str | None = None) -> subprocess.Popen:
+           stderr_path: str | None = None, mode: str = "preempt",
+           restore_dir: str | None = None) -> subprocess.Popen:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -134,10 +280,13 @@ def _spawn(workdir: str, proc: int, port: int, restore_save: int = -1,
     for k in ("CROSSCODER_MULTIHOST", "JAX_COORDINATOR_ADDRESS",
               "CROSSCODER_CHAOS"):
         env.pop(k, None)
+    cmd = [sys.executable, "-m", "crosscoder_tpu.resilience.elastic_drill",
+           "--proc", str(proc), "--port", str(port), "--workdir", workdir,
+           "--restore-save", str(restore_save), "--mode", mode]
+    if restore_dir is not None:
+        cmd += ["--restore-dir", restore_dir]
     return subprocess.Popen(
-        [sys.executable, "-m", "crosscoder_tpu.resilience.elastic_drill",
-         "--proc", str(proc), "--port", str(port), "--workdir", workdir,
-         "--restore-save", str(restore_save)],
+        cmd,
         stdout=subprocess.PIPE,
         stderr=open(stderr_path, "w") if stderr_path else subprocess.DEVNULL,
         text=True, env=env,
@@ -150,6 +299,16 @@ def _result(p: subprocess.Popen, timeout: float) -> dict:
     if not lines:
         raise RuntimeError(f"drill child produced no output (exit {p.returncode})")
     return json.loads(lines[-1])
+
+
+def _dedup_last(rows: list, from_step: int) -> list[tuple[int, str]]:
+    """A survivor logs replayed steps twice (pre-fault and post-recovery);
+    keep the LAST run of each step at or past ``from_step``."""
+    seen: dict[int, str] = {}
+    for s, h in rows:
+        if s >= from_step:
+            seen[s] = h
+    return sorted(seen.items())
 
 
 def run_drill(workdir: str | None = None, timeout: float = 420.0,
@@ -196,13 +355,7 @@ def run_drill(workdir: str | None = None, timeout: float = 420.0,
         )
 
         resume_step = remesh["step"]
-        post = [r for r in survivor["losses"] if r[0] >= resume_step]
-        # the survivor logged steps >= resume_step twice: pre-death and
-        # post-remesh — keep the LAST run of each step (the replay)
-        seen: dict[int, str] = {}
-        for s, h in post:
-            seen[s] = h
-        post = sorted(seen.items())
+        post = _dedup_last(survivor["losses"], resume_step)
         restart_post = [tuple(r) for r in restart["losses"]
                         if r[0] >= resume_step]
         return {
@@ -220,17 +373,186 @@ def run_drill(workdir: str | None = None, timeout: float = 420.0,
             tmp.cleanup()
 
 
+def run_autoscale_drill(workdir: str | None = None, timeout: float = 600.0,
+                        keep_logs: bool = False) -> dict:
+    """The full autoscale cycle (grow/shrink/grow); returns a report with
+
+    - ``survivor`` / ``joiner`` / ``clean``: the three result dicts,
+    - ``post_losses``: the survivor's post-GROW trajectory (dedup-last),
+    - ``clean_losses`` / ``joiner_losses``: the reference trajectories,
+    - ``bitwise_equal``: survivor post-grow == clean wide restart,
+    - ``joiner_equal``: joiner trajectory == survivor trajectory,
+    - ``remesh_ms`` / ``grow_ms``: the two recovery wall times.
+
+    Raises on structural failure (no shrink, no grow, joiner never
+    admitted); leaves the equality VERDICTS to the caller.
+    """
+    tmp = None
+    spawned: list[subprocess.Popen] = []
+    if workdir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="autoscale_drill_")
+        workdir = tmp.name
+    try:
+        logs = str(Path(workdir) / "autoscale_proc{}.err")
+        port = _free_port()
+        rejoin = _spawn(workdir, -2, 0, mode="rejoin",
+                        stderr_path=logs.format("j") if keep_logs else None)
+        spawned.append(rejoin)
+        ps = [
+            _spawn(workdir, proc, port, mode="autoscale",
+                   stderr_path=logs.format(proc) if keep_logs else None)
+            for proc in (0, 1)
+        ]
+        spawned += ps
+        survivor = _result(ps[0], timeout)
+        joiner = _result(rejoin, 180.0)
+        ps[1].wait(timeout=30)
+        if ps[0].returncode != 0:
+            raise RuntimeError(f"survivor exited {ps[0].returncode}")
+        if ps[1].returncode == 0:
+            raise RuntimeError("proc 1 exited cleanly; die@ chaos never fired")
+        if rejoin.returncode != 0:
+            raise RuntimeError(f"rejoin child exited {rejoin.returncode}")
+        remesh, grow = survivor.get("remesh"), survivor.get("grow")
+        if not remesh or remesh.get("save", -1) < 0:
+            raise RuntimeError(f"survivor never shrank: {survivor}")
+        if not grow or not grow.get("grown"):
+            raise RuntimeError(f"survivor never grew: {survivor}")
+
+        # the reference leg: a FRESH wide pair restoring the exact
+        # boundary save the grown world hydrated from
+        cport = _free_port()
+        cs = [
+            _spawn(workdir, proc, cport, mode="clean",
+                   restore_save=grow["save"], restore_dir=grow["version_dir"],
+                   stderr_path=logs.format(f"c{proc}") if keep_logs else None)
+            for proc in (0, 1)
+        ]
+        spawned += cs
+        clean = _result(cs[0], timeout)
+        cs[1].wait(timeout=60)
+        if cs[0].returncode != 0 or cs[1].returncode != 0:
+            raise RuntimeError(
+                f"clean pair exited {cs[0].returncode}/{cs[1].returncode}")
+
+        resume_step = grow["step"]
+        post = _dedup_last(survivor["losses"], resume_step)
+        clean_post = [tuple(r) for r in clean["losses"]
+                      if r[0] >= resume_step]
+        joiner_post = [tuple(r) for r in joiner["losses"]
+                       if r[0] >= resume_step]
+        return {
+            "survivor": survivor,
+            "joiner": joiner,
+            "clean": clean,
+            "post_losses": post,
+            "clean_losses": clean_post,
+            "joiner_losses": joiner_post,
+            "bitwise_equal": post == clean_post and len(post) > 0,
+            "joiner_equal": joiner_post == post and len(joiner_post) > 0,
+            "remesh_ms": remesh["remesh_ms"],
+            "grow_ms": grow["grow_ms"],
+            "resume_step": resume_step,
+            "steps": _AUTOSCALE["steps"],
+        }
+    finally:
+        for p in spawned:
+            if p.poll() is None:
+                p.kill()
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def run_stability_drill(workdir: str | None = None, timeout: float = 300.0,
+                        keep_logs: bool = False) -> dict:
+    """Flaky/slow chaos below the hysteresis threshold: the pair must
+    finish the run together — ZERO remeshes on either process — while the
+    counters prove the faults fired (``stable`` asserts both)."""
+    tmp = None
+    if workdir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="stability_drill_")
+        workdir = tmp.name
+    try:
+        logs = str(Path(workdir) / "stability_proc{}.err")
+        port = _free_port()
+        ps = [
+            _spawn(workdir, proc, port, mode="stability",
+                   stderr_path=logs.format(proc) if keep_logs else None)
+            for proc in (0, 1)
+        ]
+        results = [_result(p, timeout) for p in ps]
+        if any(p.returncode != 0 for p in ps):
+            raise RuntimeError(
+                f"stability pair exited "
+                f"{ps[0].returncode}/{ps[1].returncode}")
+        c0, c1 = results[0]["counters"], results[1]["counters"]
+        remeshes = (c0.get("resilience/remeshes", 0)
+                    + c1.get("resilience/remeshes", 0))
+        suspects = c0.get("resilience/elastic_suspects", 0)
+        slow = c0.get("resilience/elastic_slow_probes", 0)
+        skipped = c1.get("resilience/elastic_skipped_probes", 0)
+        finished = all(r["final_step"] == _STABILITY["steps"]
+                       for r in results)
+        return {
+            "procs": results,
+            "remeshes": remeshes,
+            "suspects": suspects,
+            "slow_probes": slow,
+            "skipped_probes": skipped,
+            "finished": finished,
+            # zero spurious remeshes AND the chaos demonstrably fired
+            "stable": (remeshes == 0 and finished
+                       and suspects >= 1 and slow >= 1 and skipped >= 1),
+            "steps": _STABILITY["steps"],
+        }
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--proc", type=int, default=None,
-                    help="child mode: 0/1 = elastic pair, -1 = clean restart")
+                    help="child mode: 0/1 = elastic pair, -1 = clean "
+                         "restart, -2 = parked rejoiner")
+    ap.add_argument("--mode", default="preempt",
+                    choices=("preempt", "autoscale", "stability", "clean",
+                             "rejoin"),
+                    help="parent: which drill to run; child: which role")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--restore-save", type=int, default=-1)
+    ap.add_argument("--restore-dir", default=None)
     ap.add_argument("--keep-logs", action="store_true")
     args = ap.parse_args(argv)
     if args.proc is None:
         # parent mode: run the whole drill, report as the last stdout line
+        if args.mode == "autoscale":
+            report = run_autoscale_drill(workdir=args.workdir,
+                                         keep_logs=args.keep_logs)
+            ok = report["bitwise_equal"] and report["joiner_equal"]
+            print(  # contracts: allow(lint-no-stdout-print) — one-line report
+                json.dumps({
+                "bitwise_equal": report["bitwise_equal"],
+                "joiner_equal": report["joiner_equal"],
+                "remesh_ms": report["remesh_ms"],
+                "grow_ms": report["grow_ms"],
+                "resume_step": report["resume_step"],
+                "post_steps": len(report["post_losses"]),
+            }))
+            return 0 if ok else 1
+        if args.mode == "stability":
+            report = run_stability_drill(workdir=args.workdir,
+                                         keep_logs=args.keep_logs)
+            print(  # contracts: allow(lint-no-stdout-print) — one-line report
+                json.dumps({
+                "stable": report["stable"],
+                "remeshes": report["remeshes"],
+                "suspects": report["suspects"],
+                "skipped_probes": report["skipped_probes"],
+                "slow_probes": report["slow_probes"],
+            }))
+            return 0 if report["stable"] else 1
         report = run_drill(workdir=args.workdir, keep_logs=args.keep_logs)
         print(  # contracts: allow(lint-no-stdout-print) — one-line report
             json.dumps({

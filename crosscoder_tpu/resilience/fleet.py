@@ -1,0 +1,156 @@
+"""Fleet autoscaling policy: which mesh shape for the capacity at hand.
+
+On every elastic membership change (shrink after a death, grow after a
+rejoin) somebody must answer "how should the ('data','model') mesh split
+the devices we now have?". The answer lives here, behind one call —
+:meth:`FleetPolicy.choose` — so the controller stays a membership
+protocol and the shape decision stays a swappable policy:
+
+- ``cfg.elastic_policy="fixed"`` (default): preserve ``model_axis_size``
+  (the TP width is a model-semantics choice — it shapes the dictionary
+  sharding the checkpoint respec re-derives) and give the data axis every
+  remaining device. This is the shape-stability contract the bitwise
+  drills lean on: a grow back to the original device count lands on the
+  original mesh, so the step HLO is identical to a clean start there.
+- ``cfg.elastic_policy="score"``: rank every valid ``(data, model)``
+  split of the device count by a modeled per-step cost — compute time
+  from the compiled step's HLO cost analysis (the PR 5 plane:
+  ``compiled.cost_analysis()`` flops, batch-split linearly across the
+  data axis) plus DP gradient-sync time from the PR 2 wire-byte model
+  (:func:`crosscoder_tpu.parallel.comm_model.wire_bytes`, extrapolated
+  to the candidate's data width via its ``axis_size`` parameter — no
+  compile needed per width, only per TP split). Candidates wider than
+  the locally compilable mesh are scored by that same extrapolation.
+
+HYSTERESIS is deliberately NOT here: dwell (min steps between remeshes)
+and debounce (consecutive fresh sightings before admission) are
+membership-time decisions and live in the :class:`ElasticController`;
+the policy is a pure function of capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+import jax
+
+# Modeled accelerator constants for the score policy, matching the
+# comm_model prediction plane: v5e public numbers — 197 bf16 TFLOP/s,
+# ~100 GB/s usable ICI per chip (see parallel/comm_model.py ICI_GBPS).
+# Absolute accuracy is irrelevant for the policy — only the RANKING of
+# candidate splits matters — but using the same constants keeps the
+# policy's numbers comparable to bench's scale-out predictions.
+PEAK_FLOPS = 197e12
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshChoice:
+    """One (data, model) split plus how the policy priced it."""
+
+    n_data: int
+    n_model: int
+    score_ms: float | None = None   # modeled per-step cost; None = unscored
+    detail: dict = dataclasses.field(default_factory=dict)
+
+
+class FleetPolicy:
+    """Mesh-shape policy over available capacity (cfg.elastic_policy)."""
+
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg
+
+    # -- the shape lattice ---------------------------------------------
+
+    def candidate_shapes(self, n_devices: int) -> list[tuple[int, int]]:
+        """Every ``(n_data, n_model)`` split of ``n_devices`` this config
+        can actually run: the model axis shards the dictionary, so it must
+        divide ``dict_size``; quant_grads and shard_sources pin pure data
+        parallelism (config validation enforces the same at build time)."""
+        cfg = self.cfg
+        out: list[tuple[int, int]] = []
+        for m in range(1, n_devices + 1):
+            if n_devices % m or cfg.dict_size % m:
+                continue
+            if m > 1 and (cfg.quant_grads or cfg.shard_sources):
+                continue
+            out.append((n_devices // m, m))
+        return out
+
+    # -- the decision --------------------------------------------------
+
+    def choose(self, n_devices: int) -> MeshChoice:
+        """The mesh shape for ``n_devices`` total devices."""
+        if self.cfg.elastic_policy == "score":
+            ranked = self.rank(n_devices)
+            if ranked:
+                return ranked[0]
+            print("[crosscoder_tpu] fleet: score policy produced no "
+                  "ranking; falling back to the fixed shape", flush=True,
+                  file=sys.stderr)
+        m = max(1, int(self.cfg.model_axis_size))
+        if n_devices % m:
+            raise ValueError(
+                f"fleet: {n_devices} devices not divisible by the fixed TP "
+                f"width model_axis_size={m}"
+            )
+        return MeshChoice(n_devices // m, m, None, {"policy": "fixed"})
+
+    def rank(self, n_devices: int) -> list[MeshChoice]:
+        """Score every candidate split, cheapest modeled step first.
+
+        Per-candidate cost = compute + DP-sync wire time. One compile per
+        distinct TP width (at the widest locally buildable data width for
+        that split); data widths beyond it reuse the same profile with
+        the wire bytes re-ringed at the candidate's axis size and the
+        flops split linearly — compilation only, no execution, so CPU
+        virtual devices handle production shapes.
+        """
+        from crosscoder_tpu.parallel import comm_model
+        from crosscoder_tpu.parallel import mesh as mesh_lib
+
+        local = jax.device_count()
+        choices: list[MeshChoice] = []
+        profiles: dict[int, tuple[float, "comm_model.CommProfile", int]] = {}
+        for n_data, n_model in self.candidate_shapes(n_devices):
+            try:
+                if n_model not in profiles:
+                    ref_data = max(1, (local // n_model))
+                    ref_mesh = mesh_lib.make_mesh(
+                        ref_data, n_model,
+                        devices=jax.devices()[: ref_data * n_model],
+                    )
+                    compiled = comm_model._compile_train_step(
+                        self.cfg, ref_mesh
+                    )
+                    cost = compiled.cost_analysis()
+                    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+                    flops = float((cost or {}).get("flops", 0.0))
+                    profile = comm_model.CommProfile(
+                        f"train_d{ref_data}_m{n_model}",
+                        ref_data * n_model, n_model,
+                        comm_model.collective_bytes(compiled.as_text()),
+                    )
+                    profiles[n_model] = (flops, profile, ref_data)
+                flops_ref, profile, ref_data = profiles[n_model]
+                # the batch axis splits linearly across the data width
+                flops_dev = flops_ref * ref_data / max(1, n_data)
+                wire = comm_model.wire_bytes(profile, axis_size=n_data)
+                score_ms = 1000.0 * (
+                    flops_dev / PEAK_FLOPS
+                    + wire / (comm_model.ICI_GBPS * 1e9)
+                )
+                choices.append(MeshChoice(
+                    n_data, n_model, score_ms,
+                    {"policy": "score", "flops_per_device": flops_dev,
+                     "wire_bytes": wire, "profiled_at": ref_data},
+                ))
+            except Exception as e:
+                print(f"[crosscoder_tpu] fleet: scoring "
+                      f"({n_data},{n_model}) failed "
+                      f"({type(e).__name__}: {e})"[:300], flush=True,
+                      file=sys.stderr)
+        # cheapest first; ties prefer the wider data axis (fewer TP
+        # collectives in programs the model does not see, e.g. harvest)
+        choices.sort(key=lambda c: (c.score_ms, -c.n_data))
+        return choices

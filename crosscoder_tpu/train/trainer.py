@@ -375,7 +375,11 @@ class Trainer:
         if cfg.elastic == "on":
             from crosscoder_tpu.resilience.elastic import ElasticController
 
-            self._elastic = ElasticController(cfg, counters=self.resilience)
+            # chaos rides along for the probe-path faults (flaky/slow) —
+            # the controller's hysteresis is what they must exercise
+            self._elastic = ElasticController(
+                cfg, counters=self.resilience, chaos=chaos
+            )
         # --- observability (cfg.obs; docs/OBSERVABILITY.md) ------------
         # None when off (the default): every hook below is a plain
         # is-None check — the compiled step HLO and the transfer counts
@@ -545,6 +549,12 @@ class Trainer:
         unless a chaos plan was injected — tests/staging only)."""
         if self.chaos is not None:
             self.chaos.on_serve(serve)
+            if self._elastic is not None and self.chaos.take_return(serve):
+                # return@serve: the fleet granted capacity back — open
+                # the rejoin window (the board write is atomic, so this
+                # is safe from the prefetch worker too); the grow itself
+                # happens at the controller's next poll boundary
+                self._elastic.open_rejoin_window(serve)
         if hasattr(self.buffer, "next_raw"):
             batch = self.buffer.next_raw()
         else:
@@ -1000,6 +1010,12 @@ class Trainer:
             # 1. quiesce: nothing may touch the dying backend past here.
             #    The prefetched batch (if any) belongs to the dead world;
             #    its production may itself have died on the torn collective.
+            #    Tickets reserved before the epoch change are invalidated
+            #    FIRST: a worker parked in a turn that will never come
+            #    would wedge the drain below behind it (the stale-epoch
+            #    ticket hazard — LaunchSequencer.invalidate).
+            if self._sequencer is not None:
+                self._sequencer.invalidate()
             try:
                 self._drain_prefetch(discard=True)
             except Exception:
@@ -1037,6 +1053,9 @@ class Trainer:
             "remesh_ms": int(ms),
         }
         self.resilience.bump("remesh_ms", int(ms))
+        # anchor the grow controller's dwell clock at the resumed step so
+        # a rejoin cannot re-mesh again before cfg.elastic_dwell_steps
+        self._elastic.note_remesh(self._host_step)
         print(f"[crosscoder_tpu] elastic: resumed at step "
               f"{self._host_step} on mesh {dict(self.mesh.shape)} "
               f"({ms:.0f} ms recovery)", flush=True, file=sys.stderr)
@@ -1065,9 +1084,92 @@ class Trainer:
         self._scale_dev = None
         self._scale_src = None
         self._resample_fn = None
+        if self._sequencer is not None:
+            # idempotent with the quiesce-path invalidate: no ticket of
+            # the old epoch may survive into the new world's ordering
+            self._sequencer.invalidate()
         self._sequencer = None
         if cfg.prefetch and multihost.needs_launch_tickets():
             self._sequencer = pipeline.LaunchSequencer()
+
+    def _grow_and_resume(self, step: int) -> None:
+        """Scale-UP recovery (cfg.elastic_grow; docs/resilience.md
+        "Elastic scale-up"): the shrunk survivor admits its debounced
+        rejoin candidates, writes the admission BOUNDARY save (state +
+        stream snapshot at exactly this step), re-forms the wider world,
+        and every member — survivor included — restores that save. Zero
+        lost steps, no fleet-wide restart, and a post-grow trajectory
+        bitwise-identical to a clean start at the wide shape from the
+        same save (the acceptance drill's equality). A failed rendezvous
+        falls back to the narrow world and keeps training. Wall time
+        accumulates in ``resilience/grow_ms``."""
+        t0 = time.perf_counter()
+        with trace.span("grow"):
+            print(f"[crosscoder_tpu] elastic: rejoin candidates debounced; "
+                  f"growing at step {step}", flush=True, file=sys.stderr)
+            # 1. quiesce, exactly like the shrink path: invalidate stale
+            #    tickets first, then drain every consumer of the backend
+            #    that is about to be reset
+            if self._sequencer is not None:
+                self._sequencer.invalidate()
+            try:
+                self._drain_prefetch(discard=True)
+            except Exception:
+                pass
+            self._pending = None
+            self._buffer_snapshot = None
+            self._quiesce_refill()
+            # 2. the boundary save: the survivor's whole trajectory (and
+            #    the stream position) becomes the joiners' hydration
+            #    point — nothing to replay, nothing to broadcast live
+            self.save()
+            self.checkpointer.wait()
+            boundary = self.checkpointer.save_version - 1
+            vdir = str(self.checkpointer.save_dir)
+            if hasattr(self.buffer, "prepare_reshard"):
+                # park the LM params to host BEFORE the backend reset
+                self.buffer.prepare_reshard()
+            # 3. admit + re-form the wider world (mesh epoch +1); on a
+            #    failed rendezvous this returns the narrow survivor mesh
+            #    and the run continues at the old width
+            mesh, admit = self._elastic.grow(
+                step, save_version=boundary, version_dir=vdir,
+                save_step=step,
+            )
+            # 4. re-derive the mesh-coupled pieces and restore the
+            #    boundary save on the new world (grown or re-shrunk) —
+            #    the explicit (version_dir, save) pin keeps the restore
+            #    SPMD-symmetric with the joiners' (no negotiation)
+            self._rebuild_for_mesh(mesh)
+            if hasattr(self.buffer, "reshard"):
+                self.buffer.reshard(self._batch_sharding, refill=False)
+            meta = self.restore(version_dir=vdir, save=boundary)
+            if admit is not None:
+                # hydration barrier: nobody trains until every member has
+                # restored the boundary save — without it the survivor's
+                # first probe would time out on a joiner still compiling,
+                # burning a suspect for pure startup stagger
+                if not multihost.probe_liveness(
+                        f"r{int(admit['epoch'])}", timeout_s=120.0):
+                    print("[crosscoder_tpu] elastic: hydration barrier "
+                          "timed out; training on (the probe path will "
+                          "catch a dead joiner)", flush=True,
+                          file=sys.stderr)
+        ms = 1000 * (time.perf_counter() - t0)
+        self._elastic.note_remesh(self._host_step)
+        self.last_grow = {
+            "step": int(meta.get("step", -1)),
+            "save": int(boundary),
+            "version_dir": vdir,
+            "epoch": self._elastic.epoch(),
+            "grow_ms": int(ms),
+            "grown": admit is not None,
+            "n_data": int(self.mesh.shape.get("data", 1)),
+        }
+        self.resilience.bump("grow_ms", int(ms))
+        print(f"[crosscoder_tpu] elastic: resumed at step "
+              f"{self._host_step} on mesh {dict(self.mesh.shape)} "
+              f"({ms:.0f} ms grow recovery)", flush=True, file=sys.stderr)
 
     def train(self, num_steps: int | None = None) -> dict[str, float]:
         """Run the training loop (reference ``trainer.py:72-82`` semantics:
@@ -1206,6 +1308,22 @@ class Trainer:
                             raise PeerLoss(
                                 f"peer lost (liveness probe, step {i})"
                             )
+                        # elastic scale-UP (cfg.elastic_grow): only the
+                        # shrunk single-process survivor polls the
+                        # rendezvous board; when candidates have passed
+                        # debounce + dwell it grows the world at this
+                        # step boundary and restarts the epoch loop on
+                        # the wider mesh
+                        if (self._elastic is not None
+                                and self.checkpointer is not None
+                                and self._elastic.grow_ready(i)):
+                            if profiler is not None:
+                                profiler.stop_if_active()
+                            getattr(progress, "close", lambda: None)()
+                            self._grow_and_resume(i)
+                            multi_process = jax.process_count() > 1
+                            rolled_back = True
+                            break
                         if _stop_agreed(i):
                             break
                         if profiler is not None:

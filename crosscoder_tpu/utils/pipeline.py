@@ -92,6 +92,7 @@ class LaunchSequencer:
         self._next = 0       # next ticket to hand out
         self._head = 0       # lowest ticket not yet released
         self._released: set[int] = set()
+        self._invalid = False
 
     def reserve(self) -> int:
         """Claim the next launch slot (call on the deciding thread, in
@@ -107,7 +108,7 @@ class LaunchSequencer:
         earlier ticket has released; exit releases this one (also on
         exceptions, so a failed launch never wedges the sequence)."""
         with self._cond:
-            while self._head != ticket:
+            while not self._invalid and self._head != ticket:
                 self._cond.wait()
         try:
             yield
@@ -122,6 +123,21 @@ class LaunchSequencer:
             while self._head in self._released:
                 self._released.remove(self._head)
                 self._head += 1
+            self._cond.notify_all()
+
+    def invalidate(self) -> None:
+        """Retire the whole sequence at a mesh-epoch change (elastic
+        shrink/grow re-mesh). Tickets reserved before the epoch change
+        order launches against a backend that is about to be torn down:
+        their ordering no longer means anything, but a ticket that was
+        reserved and never released would block every later ``turn`` —
+        including the quiesce drain of the old world's in-flight work —
+        behind a turn that can never come. After ``invalidate`` every
+        outstanding and future ticket passes straight through ``turn``
+        (the trainer builds a FRESH sequencer for the new epoch's world,
+        so post-remesh ordering starts clean)."""
+        with self._cond:
+            self._invalid = True
             self._cond.notify_all()
 
 

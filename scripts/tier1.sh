@@ -24,4 +24,11 @@ env JAX_PLATFORMS=cpu python -m pytest \
 # (docs/resilience.md "Elastic membership"; exit 0 iff bitwise_equal)
 env JAX_PLATFORMS=cpu python -m crosscoder_tpu.resilience.elastic_drill \
     || exit 1
+# elastic autoscale smoke: the full grow/shrink/grow cycle on 2+1 real CPU
+# processes — die@S kills a host, return@S grants capacity back, a parked
+# rejoiner is admitted at a step boundary, and the grown world must finish
+# bitwise-equal to a clean restart at the wide shape (docs/resilience.md
+# "Elastic scale-up"; exit 0 iff bitwise_equal AND joiner_equal)
+env JAX_PLATFORMS=cpu python -m crosscoder_tpu.resilience.elastic_drill \
+    --mode autoscale || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

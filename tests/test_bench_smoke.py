@@ -105,6 +105,9 @@ def test_bench_compact_summary_is_small_and_gated():
         "dash": {"steady_s": 15.0, "vs_reference": 1.27},
         "elastic": {"remesh_ms": 1500, "bitwise_equal": True,
                     "resume_step": 6, "post_steps": 4,
+                    "grow_ms": 1300, "autoscale_bitwise_equal": True,
+                    "joiner_equal": True, "autoscale_cycle_s": 38.5,
+                    "autoscale_resume_step": 10,
                     "workload": "w" * 80},
     }
     out = bench._compact(headline, results)
@@ -114,8 +117,12 @@ def test_bench_compact_summary_is_small_and_gated():
         "refill_overlap.gate_ok": True, "quant.quality_gate_ok": True,
         "obs.overhead_gate_ok": True, "e2e.loss_finite": True,
         "elastic.bitwise_equal": True,
+        "elastic.autoscale_bitwise_equal": True,
     }
     assert out["elastic"]["remesh_ms"] == 1500
+    # the scale-UP leg's headline numbers ride the same compact line
+    assert out["elastic"]["grow_ms"] == 1300
+    assert out["elastic"]["autoscale_cycle_s"] == 38.5
     assert out["step_ratio_vs_relu"]["topk_dense@32768"] == round(
         150000.0 / 140000.0, 3)
     assert out["step_ratio_vs_relu"]["batchtopk_pallas@262144"] == "skip"

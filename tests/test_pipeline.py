@@ -136,6 +136,36 @@ def test_sequencer_out_of_order_release():
         pass                    # would hang if the head stuck at b
 
 
+def test_sequencer_invalidate_releases_stale_tickets():
+    """The stale-epoch ticket hazard (elastic shrink/grow): a ticket
+    reserved before a re-mesh and never released must not block the
+    quiesce drain behind a turn that can never come — ``invalidate``
+    lets every blocked AND future turn pass straight through."""
+    seq = pipeline.LaunchSequencer()
+    seq.reserve()                       # a — orphaned by the epoch change
+    b = seq.reserve()
+    started, done = threading.Event(), []
+
+    def blocked_turn():
+        started.set()
+        with seq.turn(b):               # blocks: a never releases
+            done.append(b)
+
+    t = threading.Thread(target=blocked_turn)
+    t.start()
+    assert started.wait(timeout=5)
+    time.sleep(0.05)
+    assert done == []                   # genuinely wedged behind a
+    seq.invalidate()
+    t.join(timeout=5)
+    assert done == [b]
+    # post-invalidate reservations pass through without any release
+    c = seq.reserve()
+    with seq.turn(c):
+        done.append(c)
+    assert done == [b, c]
+
+
 # ---------------------------------------------------------------------------
 # QuantumDispatcher — the refill engine's offloaded dispatch thread
 

@@ -139,6 +139,128 @@ def test_same_mesh_restores_are_bitwise_deterministic(tmp_path):
     assert len(tapes[0]) == 3                   # steps 3..5 replayed once
 
 
+def test_grow_narrow_to_wide_restore(tmp_path):
+    """The elastic scale-UP direction: a save written by the shrunk
+    narrow world (1×4 over half the devices) restores onto the grown
+    wide mesh (2×4) — params/opt/step exact, quant_ef created fresh at
+    the new data width (there was nothing to carry: width 1 keeps no
+    residuals)."""
+    cfg = _cfg(tmp_path)
+    narrow = mesh_lib.make_mesh(1, 4, devices=jax.devices()[:4])
+    wide = mesh_lib.make_mesh(2, 4)
+
+    a = Trainer(cfg, mesh=narrow,
+                checkpointer=Checkpointer(base_dir=tmp_path))
+    assert _ef_widths(a.state) is None
+    for _ in range(2):
+        a.step()
+    a.save()
+    want = {k: np.asarray(Checkpointer._fetch_global(v), np.float32)
+            for k, v in a.state.params.items()}
+    a.close()
+
+    b = Trainer(cfg, mesh=wide, checkpointer=Checkpointer(base_dir=tmp_path))
+    meta = b.restore()
+    assert int(meta["step"]) == 2
+    assert _ef_widths(b.state) == {2}           # grown width, zero-init
+    for leaf in jax.tree_util.tree_leaves((b.state.aux or {})["quant_ef"]):
+        np.testing.assert_array_equal(np.asarray(leaf), 0)
+    for k in want:
+        np.testing.assert_array_equal(
+            np.asarray(Checkpointer._fetch_global(b.state.params[k]),
+                       np.float32), want[k], err_msg=k)
+    assert np.isfinite(float(jax.device_get(b.step()["loss"])))
+    b.close()
+
+
+def test_grow_cycle_wide_narrow_wide(tmp_path):
+    """The full autoscale cycle at fixed process count: wide (2×4) →
+    shrink to the narrow survivor (1×4, quant_ef dropped) → grow back to
+    wide (quant_ef re-created). Each hop round-trips the params exactly
+    and steps to a finite loss — the in-process mirror of the 2-process
+    grow/shrink/grow drill."""
+    cfg = _cfg(tmp_path)
+    wide = mesh_lib.make_mesh(2, 4)
+    narrow = mesh_lib.make_mesh(1, 4, devices=jax.devices()[:4])
+
+    a = Trainer(cfg, mesh=wide, checkpointer=Checkpointer(base_dir=tmp_path))
+    for _ in range(2):
+        a.step()
+    a.save()
+    a.close()
+
+    b = Trainer(cfg, mesh=narrow,
+                checkpointer=Checkpointer(base_dir=tmp_path))
+    meta = b.restore()
+    assert int(meta["step"]) == 2
+    assert _ef_widths(b.state) is None          # respec dropped them
+    assert np.isfinite(float(jax.device_get(b.step()["loss"])))
+    b.save()
+    want = {k: np.asarray(Checkpointer._fetch_global(v), np.float32)
+            for k, v in b.state.params.items()}
+    b.close()
+
+    c = Trainer(cfg, mesh=wide, checkpointer=Checkpointer(base_dir=tmp_path))
+    meta = c.restore()
+    assert int(meta["step"]) == 3
+    assert _ef_widths(c.state) == {2}           # re-specced for the grow
+    for k in want:
+        np.testing.assert_array_equal(
+            np.asarray(Checkpointer._fetch_global(c.state.params[k]),
+                       np.float32), want[k], err_msg=k)
+    assert np.isfinite(float(jax.device_get(c.step()["loss"])))
+    c.close()
+
+
+@pytest.mark.slow
+def test_buffer_stream_bitwise_across_grow_reshard():
+    """The data-plane leg of scale-UP, through a full shrink-then-grow
+    cycle (prepare_reshard/reshard are per-cycle re-entrant): after the
+    buffer reshards BACK to the wide batch layout, its served sequence
+    must be bitwise-equal to a fresh wide buffer restored from the same
+    stream snapshot — the stream position, not the store bytes, is the
+    state."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crosscoder_tpu.data.buffer import make_buffer
+    from crosscoder_tpu.models import lm
+
+    lm_cfg = lm.LMConfig.tiny()
+    params = [lm.init_params(jax.random.key(0), lm_cfg),
+              lm.init_params(jax.random.key(1), lm_cfg)]
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 257, size=(256, 17), dtype=np.int64)
+    cfg = CrossCoderConfig(
+        batch_size=32, buffer_mult=32, seq_len=17, d_in=32, n_models=2,
+        model_batch_size=4, norm_calib_batches=2, seed=3,
+        hook_point="blocks.2.hook_resid_pre", buffer_device="hbm",
+    )
+    wide = NamedSharding(mesh_lib.make_mesh(2, 4), P("data", None))
+    narrow = NamedSharding(
+        mesh_lib.make_mesh(1, 4, devices=jax.devices()[:4]),
+        P("data", None))
+
+    b = make_buffer(cfg, lm_cfg, params, tokens, batch_sharding=wide)
+    for _ in range(3):
+        b.next()
+    b.prepare_reshard()                 # the shrink leg...
+    b.reshard(narrow, refill=True)
+    for _ in range(2):
+        b.next()
+    snap = b.state_dict()
+
+    b.prepare_reshard()                 # ...and the GROW leg back
+    b.reshard(wide, refill=True)
+
+    ref = make_buffer(cfg, lm_cfg, params, tokens, batch_sharding=wide,
+                      lazy=True)
+    ref.load_state_dict(snap)
+    for step in range(6):
+        np.testing.assert_array_equal(
+            np.asarray(b.next(), np.float32),
+            np.asarray(ref.next(), np.float32), err_msg=f"step {step}")
+
+
 def test_foreign_extra_ef_is_tolerated_positionally_strict(tmp_path):
     """The positional (legacy leaf_i) layout keeps the strict count
     contract — respec only relaxes PATH-KEYED checkpoints, so old-format
