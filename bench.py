@@ -1,6 +1,6 @@
 """Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Ten sections (env ``BENCH_SECTIONS``, default all; progress on stderr).
+Eleven sections (env ``BENCH_SECTIONS``, default all; progress on stderr).
 Output contract: stdout carries exactly ONE machine-parseable JSON line,
 guaranteed last and guaranteed **compact** (≤2 KB: headline, per-section
 key numbers, gate booleans) — the driver truncates the line at 2000
@@ -1197,6 +1197,121 @@ def section_elastic() -> dict:
     return out
 
 
+def section_fleet() -> dict:
+    """Fleet amortization A/B (docs/SCALING.md "Fleet amortization"): N
+    shape-identical tenants trained as ONE vmapped cohort off one harvest
+    stream vs N sequential solo runs, each paying its own calibration,
+    fill, and per-step refill harvest. Reported as aggregate acts/s/chip
+    both ways plus ``harvest_amortization`` (their ratio — the
+    sweep-level speedup). Gate (ISSUE 17 acceptance): ratio >= 3.0 with
+    every loss finite; dict is kept small so harvest dominates, the
+    regime the fleet exists for."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crosscoder_tpu.data.buffer import make_buffer
+    from crosscoder_tpu.models import lm
+    from crosscoder_tpu.parallel import mesh as mesh_lib
+    from crosscoder_tpu.train.fleet import FleetScheduler
+    from crosscoder_tpu.train.trainer import Trainer
+
+    tiny = os.environ.get("BENCH_TINY") == "1"    # CI/debug only
+    n_tenants = int(os.environ.get("BENCH_FLEET_TENANTS", 4))
+    n_steps = int(os.environ.get("BENCH_FLEET_STEPS", 40))
+    if tiny:
+        # 12 scanned layers: deep enough that the harvest (the shared
+        # cost) dominates the tiny crosscoder step, the production regime
+        hook_layer, lm_cfg = 12, lm.LMConfig.tiny(n_layers=12)
+        shape = dict(d_in=lm_cfg.d_model, dict_size=64, batch_size=256,
+                     buffer_mult=16, model_batch_size=4,
+                     norm_calib_batches=2, seq_len=17,
+                     hook_point="blocks.12.hook_resid_pre")
+    else:
+        hook_layer = 14
+        lm_cfg = lm.LMConfig.gemma2_2b().replace(n_layers=hook_layer)
+        shape = dict(dict_size=2048, batch_size=4096, buffer_mult=32,
+                     model_batch_size=4, norm_calib_batches=8,
+                     seq_len=1024,
+                     hook_point=f"blocks.{hook_layer}.hook_resid_pre")
+    n_dev = len(jax.devices())
+    mesh = mesh_lib.make_mesh(data_axis_size=n_dev, model_axis_size=1)
+    batch_sh = NamedSharding(mesh, P("data", None))
+    params = [lm.init_params(jax.random.key(i), lm_cfg) for i in (0, 1)]
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, lm_cfg.vocab_size,
+                          size=(2048, shape["seq_len"]), dtype=np.int32)
+
+    def cfg_for(**kw):
+        return _make_cfg(num_tokens=10**12, save_every=10**9,
+                         **{**shape, **kw})
+
+    # N sequential solo runs: each pays its own per-step refill harvest —
+    # exactly the cost the fleet amortizes. Steady-state measurement:
+    # compiles and the first fill stay outside the timed window on BOTH
+    # sides (acts/s is a rate; one-time setup is reported separately).
+    solo_wall = 0.0
+    fill_s = 0.0
+    losses = []
+    for i in range(n_tenants):
+        cfg = cfg_for(seed=i + 1)
+        t0 = time.perf_counter()
+        buf = make_buffer(cfg, lm_cfg, params, tokens,
+                          batch_sharding=batch_sh)
+        tr = Trainer(cfg, buf, mesh=mesh)
+        for _ in range(4):
+            tr.step(full_metrics=False)       # warmup: compile + serve path
+        fill_s += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            m = tr.step(full_metrics=False)
+        losses.append(_sync(m["loss"]))
+        solo_wall += time.perf_counter() - t0
+        tr.close()
+        log(f"[fleet] solo {i + 1}/{n_tenants}: "
+            f"cumulative {solo_wall:.1f}s steady + {fill_s:.1f}s setup")
+
+    tenants = ";".join(f"t{i}:seed={i + 1}" for i in range(n_tenants))
+    cfg = cfg_for(fleet="on", fleet_tenants=tenants)
+    t0 = time.perf_counter()
+    buf = make_buffer(cfg, lm_cfg, params, tokens, batch_sharding=batch_sh)
+    fl = FleetScheduler(cfg, buffer=buf, mesh=mesh, checkpoint=False)
+    for _ in range(4):
+        fl.step_all(full_metrics=False)
+    fleet_fill_s = time.perf_counter() - t0
+    mets: dict = {}
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        mets = fl.step_all(full_metrics=False)
+    losses += [_sync(mets[n]["loss"]) for n in fl.active()]
+    fleet_wall = time.perf_counter() - t0
+    buf.close()
+
+    total_acts = n_tenants * n_steps * cfg.batch_size
+    fleet_agg = total_acts / fleet_wall / n_dev
+    solo_agg = total_acts / solo_wall / n_dev
+    ratio = fleet_agg / solo_agg
+    finite = all(bool(jnp.isfinite(x)) for x in losses)
+    out = {
+        "n_tenants": n_tenants,
+        "n_steps": n_steps,
+        "agg_acts_per_sec_chip": round(fleet_agg, 1),
+        "solo_agg_acts_per_sec_chip": round(solo_agg, 1),
+        "harvest_amortization": round(ratio, 2),
+        "fleet_gate_ok": bool(ratio >= 3.0 and finite),
+        "loss_finite": finite,
+        "solo_setup_s": round(fill_s, 1),
+        "fleet_setup_s": round(fleet_fill_s, 1),
+        "workload": (
+            f"{n_tenants}× seed tenants as one vmapped cohort off one "
+            f"{'tiny' if tiny else 'gemma-2-2b'}-shaped harvest stream vs "
+            f"{n_tenants} sequential solo runs (dict {cfg.dict_size}, "
+            f"batch {cfg.batch_size})"
+        ),
+    }
+    log(f"[fleet] {out}")
+    return out
+
+
 # stdout-summary projection: per section, the fields worth the 2 KB line
 _SUMMARY_KEYS = {
     "step": ("acts_per_sec_chip", "vs_a100_step"),
@@ -1209,11 +1324,14 @@ _SUMMARY_KEYS = {
     "dash": ("steady_s", "vs_reference"),
     "elastic": ("remesh_ms", "bitwise_equal", "grow_ms",
                 "autoscale_cycle_s"),
+    "fleet": ("agg_acts_per_sec_chip", "solo_agg_acts_per_sec_chip",
+              "harvest_amortization", "fleet_gate_ok"),
 }
 _GATES = (("refill_overlap", "gate_ok"), ("quant", "quality_gate_ok"),
           ("obs", "overhead_gate_ok"), ("e2e", "loss_finite"),
           ("elastic", "bitwise_equal"),
-          ("elastic", "autoscale_bitwise_equal"))
+          ("elastic", "autoscale_bitwise_equal"),
+          ("fleet", "fleet_gate_ok"))
 
 
 def _compact(headline: dict, results: dict) -> dict:
@@ -1309,7 +1427,7 @@ def _run_sections() -> dict:
     sections = os.environ.get(
         "BENCH_SECTIONS",
         "step,matrix,configs,e2e,refill_overlap,harvest,quant,obs,dash,"
-        "elastic"
+        "elastic,fleet"
     ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
@@ -1319,7 +1437,8 @@ def _run_sections() -> dict:
                      ("harvest", section_harvest),
                      ("quant", section_quant), ("obs", section_obs),
                      ("dash", section_dash),
-                     ("elastic", section_elastic)):
+                     ("elastic", section_elastic),
+                     ("fleet", section_fleet)):
         if name not in sections:
             continue
         try:

@@ -213,6 +213,8 @@ KNOB_OFF_LATTICE: tuple[tuple[str, dict[str, Any]], ...] = (
                           checkpoint_dir="/tmp/ckpt",
                           elastic_suspect_probes=3, elastic_dwell_steps=5,
                           elastic_grow_debounce=4, elastic_policy="score")),
+    ("fleet", dict(fleet="on", fleet_tenants="a:seed=1;b:seed=2",
+                   fleet_max_buckets=4, checkpoint_dir="/tmp/ckpt")),
     ("all_knobs", dict(quant_buffer=True, quant_block=8, obs="on",
                        harvest_runtime="paged", page_size=16, seq_len=1024,
                        guard_loss=True, log_backend="jsonl",
@@ -355,6 +357,27 @@ def _check_elastic_grow_off(ctx: StepContext) -> list[Finding]:
     return out
 
 
+def _check_fleet_off(ctx: StepContext) -> list[Finding]:
+    """The multi-tenant fleet (``cfg.fleet`` and its tenant-roster /
+    bucket-cap knobs) is a SCHEDULER around the step, not a step change:
+    tenant fan-out, stacked cohorts, and compile buckets all live in
+    train/fleet.py's host loop, so with every fleet knob set the SOLO
+    train step must still lower byte-identically to the bare baseline
+    (docs/SCALING.md "Fleet amortization"). Own rule, own mutation
+    self-test, own name in the report."""
+    out = []
+    for a, b, knob in ctx.identity_pairs:
+        if knob != "fleet" or ctx.texts[a] == ctx.texts[b]:
+            continue
+        out.append(Finding(
+            rule="hlo-fleet-off-identity", location=f"{a} vs {b}",
+            message="fleet/fleet_tenants/fleet_max_buckets changed the "
+                    "compiled step program — the fleet scheduler must be "
+                    "invisible to the solo step lowering",
+        ))
+    return out
+
+
 def _check_no_s8(ctx: StepContext) -> list[Finding]:
     out = []
     for label, text in ctx.texts.items():
@@ -472,6 +495,9 @@ HLO_RULES: list[Rule] = [
     Rule("hlo-elastic-grow-off-identity",
          "the elastic scale-up plane never changes the step lowering",
          _is_step_ctx, _check_elastic_grow_off),
+    Rule("hlo-fleet-off-identity",
+         "the multi-tenant fleet scheduler never changes the step lowering",
+         _is_step_ctx, _check_fleet_off),
 ]
 
 

@@ -85,6 +85,15 @@ def _mut_elastic_grow() -> StepContext:
     return ctx
 
 
+def _mut_fleet() -> StepContext:
+    ctx = _step_ctx()
+    ctx.texts["off:fleet"] = _CLEAN_HLO + "// an extra lowered op\n"
+    ctx.meta["off:fleet"] = VariantMeta(n_donated_leaves=1)
+    ctx.jaxpr_consts["off:fleet"] = []
+    ctx.identity_pairs = [("base", "off:fleet", "fleet")]
+    return ctx
+
+
 def _mut_s8() -> StepContext:
     ctx = _step_ctx()
     ctx.texts["base"] += "  %q = stablehlo.convert : tensor<32x8xi8>\n"
@@ -224,6 +233,7 @@ MUTATIONS: dict[str, Callable[[], Any]] = {
     "hlo-refill-overlap-off-identity": _mut_refill_overlap,
     "hlo-elastic-off-identity": _mut_elastic,
     "hlo-elastic-grow-off-identity": _mut_elastic_grow,
+    "hlo-fleet-off-identity": _mut_fleet,
     "hlo-no-s8-when-quant-off": _mut_s8,
     "hlo-no-f64": _mut_f64,
     "hlo-donation-honored": _mut_donation,

@@ -106,9 +106,22 @@ class Checkpointer:
         cfg: CrossCoderConfig | None = None,
         chaos: Any | None = None,
         counters: Any | None = None,
+        tenant: str | None = None,
     ) -> None:
         if base_dir is None:
             base_dir = cfg.checkpoint_dir if cfg is not None else "./checkpoints"
+        if tenant is not None:
+            # fleet namespacing (train/fleet.py): each tenant's saves live
+            # under <base>/tenants/<name>/ with their OWN version_* dirs,
+            # so keep-last-k retention (`_prune_saves`, scoped to one
+            # version dir) counts and prunes PER TENANT — a 4-tenant fleet
+            # with keep_saves=3 keeps 3 saves per tenant, never reaping a
+            # sibling's. A shared flat dir would interleave all tenants'
+            # monotone save numbers and retention would reap globally.
+            if not tenant or "/" in tenant or tenant in (".", ".."):
+                raise ValueError(f"invalid tenant name {tenant!r}")
+            base_dir = Path(base_dir) / "tenants" / tenant
+        self.tenant = tenant
         self.base_dir = Path(base_dir)
         self.save_dir: Path | None = None
         self.save_version = 0

@@ -415,6 +415,30 @@ class CrossCoderConfig:
                                     # ranks candidate (data, model) splits
                                     # by the comm_model wire-byte model +
                                     # compiled-HLO cost analysis
+    # --- multi-tenant fleet (train/fleet.py; docs/SCALING.md "Fleet
+    # amortization"). Off by default and ZERO-COST off: none of these
+    # knobs is read inside the compiled step, so the step lowering is
+    # byte-identical to a build without them (contracts rule
+    # hlo-fleet-off-identity).
+    fleet: str = "off"              # off | on: run N crosscoder tenants
+                                    # off ONE shared replay buffer — one
+                                    # harvest stream, one serve gather per
+                                    # cycle fanned out to every admitted
+                                    # tenant, so the LM forward amortizes
+                                    # across the whole sweep
+    fleet_tenants: str = ""         # fleet="on" CLI sweep spec:
+                                    # ';'-separated "name:k=v,k=v" tenant
+                                    # overrides applied to the base config
+                                    # (e.g. "a:seed=1;b:seed=2,l1_coeff=
+                                    # 0.02;big:dict_size=65536"). seed/
+                                    # l1_coeff-only variations stack under
+                                    # one vmapped step; shape-changing
+                                    # overrides compile into buckets
+    fleet_max_buckets: int = 8      # fleet="on": cap on DISTINCT compiled
+                                    # step signatures across heterogeneous
+                                    # tenants (stacked cohorts count one) —
+                                    # admission beyond the cap is refused
+                                    # rather than compiling unboundedly
     # --- block-scaled int8 data plane (ops/quant.py; docs/SCALING.md
     # "Quantized data plane"). Both off by default and ZERO-COST off: the
     # compiled train step and the serve/refill paths are byte-identical to
@@ -795,6 +819,27 @@ class CrossCoderConfig:
                     f"elastic_grow_debounce must be >= 1, got "
                     f"{self.elastic_grow_debounce}"
                 )
+        _check_choice("fleet", self.fleet, ("off", "on"))
+        if self.fleet == "on":
+            if self.fleet_max_buckets < 1:
+                raise ValueError(
+                    f"fleet_max_buckets must be >= 1, got "
+                    f"{self.fleet_max_buckets} (each stacked cohort and "
+                    f"each heterogeneous tenant signature costs one "
+                    f"compile bucket)"
+                )
+            if self.quant_grads:
+                raise ValueError(
+                    "fleet='on' is incompatible with quant_grads: the "
+                    "stacked (vmapped) tenant step cannot nest the "
+                    "shard_map quantized all-reduce; train quantized "
+                    "sweeps as sequential solo runs"
+                )
+        elif self.fleet_tenants:
+            raise ValueError(
+                "fleet_tenants is set but fleet='off'; pass --fleet on "
+                "(the spec would otherwise be silently ignored)"
+            )
         if self.quant_block < 1:
             raise ValueError(
                 f"quant_block must be >= 1, got {self.quant_block}; 256 is "

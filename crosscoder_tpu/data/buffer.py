@@ -254,6 +254,15 @@ class PairedActivationBuffer:
         self._src_global = np.zeros(self.buffer_size, dtype=np.int64)
         self.first = True
         self._filled = False
+        # multi-consumer fan-out (fleet serving; train/fleet.py): one real
+        # gather per stream position, cached and handed to every attached
+        # consumer whose cursor sits at that position. _serve_seq counts
+        # REAL serves (solo next()/next_raw() calls advance it too, so a
+        # consumer attached mid-stream starts at the true next position).
+        self._serve_seq = 0
+        self._consumers: dict[str, int] = {}
+        self._fanout_batch: np.ndarray | None = None
+        self._fanout_seq = -1
 
         if not lazy:
             # lazy=True defers calibration+fill to load_state_dict() so a
@@ -837,9 +846,61 @@ class PairedActivationBuffer:
         cycle at the reference's trigger point (reference ``buffer.py:121``)
         — by which time the incremental dispatches have already landed
         nearly all of it."""
+        self._serve_seq += 1
         self._advance_cycle()
         if self.pointer > self.buffer_size // 2 - self.cfg.batch_size:
             self._finish_cycle()
+
+    # ------------------------------------------------------------------
+    # multi-consumer fan-out (fleet serving; train/fleet.py)
+
+    def attach_consumer(self, name: str) -> int:
+        """Register a fan-out consumer at the CURRENT stream position and
+        return that position. Each consumer gets a deterministic cursor
+        into the one shared serve stream: the sequence of batches it is
+        handed from here on is bitwise the sequence a solo run of this
+        buffer (same cfg.seed) would serve from the same position — the
+        fleet's per-tenant determinism contract."""
+        if name in self._consumers:
+            raise ValueError(f"consumer {name!r} already attached")
+        self._consumers[name] = self._serve_seq
+        return self._serve_seq
+
+    def detach_consumer(self, name: str) -> None:
+        """Retire a consumer; its cursor is dropped (any cached batch stays
+        for the remaining consumers at that position)."""
+        self._consumers.pop(name, None)
+
+    def consumer_cursor(self, name: str) -> int:
+        return self._consumers[name]
+
+    def next_raw_for(self, name: str) -> np.ndarray:
+        """Serve the batch at ``name``'s cursor, advancing the cursor.
+
+        ONE real gather per stream position no matter how many consumers:
+        the first consumer to reach a position pays :meth:`next_raw` (one
+        ``native.gather_rows`` + the refill bookkeeping); every other
+        consumer at the same position is handed the cached array. The
+        scheduler steps tenants in lockstep rounds, so the cache never
+        needs more than one position of depth — a cursor that is neither
+        at the cached position nor at the stream head indicates a broken
+        lockstep and raises rather than silently re-gathering."""
+        cur = self._consumers[name]
+        if cur == self._fanout_seq:
+            batch = self._fanout_batch
+        elif cur == self._serve_seq:
+            batch = self.next_raw()
+            self._fanout_seq = cur
+            self._fanout_batch = batch
+        else:
+            raise RuntimeError(
+                f"fan-out consumer {name!r} at position {cur} is out of "
+                f"lockstep (cached={self._fanout_seq}, "
+                f"head={self._serve_seq}): consumers must drain each "
+                f"stream position together"
+            )
+        self._consumers[name] = cur + 1
+        return batch
 
     # ------------------------------------------------------------------
     # resume support (no reference counterpart)
@@ -875,6 +936,14 @@ class PairedActivationBuffer:
         self._cyc_inflight = []
         self._cyc_job = None
         self._cyc_seq_done = 0
+        # the restored stream position is the new head: any cached fan-out
+        # batch belongs to the superseded stream, and every attached
+        # consumer re-aligns to the restore point (the fleet restores all
+        # tenants from the same boundary save, so their cursors agree)
+        self._fanout_batch = None
+        self._fanout_seq = -1
+        for _name in self._consumers:
+            self._consumers[_name] = self._serve_seq
         # restore must be independent of pre-restore buffer history: reset
         # the permutation so the refill lands rows in harvest order, exactly
         # as a freshly-constructed buffer's restore does (determinism A2) —
@@ -980,6 +1049,8 @@ class PairedActivationBuffer:
         self._src_global = np.zeros(self.buffer_size, dtype=np.int64)
         self.first = True
         self._filled = False
+        self._fanout_batch = None       # cached batch died with the old store
+        self._fanout_seq = -1
         self._alloc_store()
         if (self._overlap and self._DISPATCH_THREAD_OK
                 and self._dispatcher is None and jax.process_count() == 1):

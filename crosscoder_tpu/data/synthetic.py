@@ -36,6 +36,12 @@ class SyntheticActivationSource:
         d /= np.linalg.norm(d, axis=-1, keepdims=True)
         self.dictionary = d
         self.counter = 0
+        # multi-consumer fan-out (train/fleet.py): same protocol as the
+        # replay buffer — one real generation per stream position, cached
+        # for every consumer whose cursor sits there
+        self._consumers: dict[str, int] = {}
+        self._fanout_batch: np.ndarray | None = None
+        self._fanout_seq = -1
 
     def next(self) -> np.ndarray:
         cfg = self.cfg
@@ -58,9 +64,46 @@ class SyntheticActivationSource:
             x += mag[:, j, None, None] * self.dictionary[idx[:, j]]
         return x
 
+    # --- multi-consumer fan-out (fleet serving; train/fleet.py) ---
+    def attach_consumer(self, name: str) -> int:
+        if name in self._consumers:
+            raise ValueError(f"consumer {name!r} already attached")
+        self._consumers[name] = self.counter
+        return self.counter
+
+    def detach_consumer(self, name: str) -> None:
+        self._consumers.pop(name, None)
+
+    def consumer_cursor(self, name: str) -> int:
+        return self._consumers[name]
+
+    def next_for(self, name: str) -> np.ndarray:
+        """Batch at ``name``'s cursor: the first consumer to reach a
+        position pays the real :meth:`next`; peers at the same position
+        get the cached array. Bitwise the solo stream — batch ``i`` is a
+        pure function of ``(seed, i)`` either way."""
+        cur = self._consumers[name]
+        if cur == self._fanout_seq:
+            batch = self._fanout_batch
+        elif cur == self.counter:
+            batch = self.next()
+            self._fanout_seq = cur
+            self._fanout_batch = batch
+        else:
+            raise RuntimeError(
+                f"fan-out consumer {name!r} at position {cur} is out of "
+                f"lockstep (cached={self._fanout_seq}, head={self.counter})"
+            )
+        self._consumers[name] = cur + 1
+        return batch
+
     # --- checkpointable pipeline state (step counter only) ---
     def state_dict(self) -> dict:
         return {"counter": self.counter}
 
     def load_state_dict(self, d: dict) -> None:
         self.counter = int(d["counter"])
+        self._fanout_batch = None
+        self._fanout_seq = -1
+        for _name in self._consumers:
+            self._consumers[_name] = self.counter

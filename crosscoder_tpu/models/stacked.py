@@ -1,0 +1,91 @@
+"""Stacked-tenant plumbing for the fleet scheduler (train/fleet.py).
+
+A fleet cohort of shape-identical tenants (same d_in/dict_size/k — they
+differ only in seed or l1/aux hyperparameters) trains as ONE program: the
+solo step body from :func:`crosscoder_tpu.train.trainer.make_step_body`
+is ``jax.vmap``-ed over a leading tenant axis on the TrainState, with the
+batch and norm scale broadcast (in_axes=None — the whole point: every
+tenant trains on the SAME served batch, so the harvest and the H2D
+transfer are paid once per cohort, not per tenant) and the per-tenant
+``l1_base`` vector mapped. One compile, one dispatch per cohort step.
+
+vmap of a batched einsum is the same einsum with one more batch dim — on
+CPU and TPU the per-tenant lanes run the identical contraction the solo
+step runs, which is what makes the per-tenant loss trajectories bitwise
+equal to solo runs (asserted in tests/test_fleet.py).
+
+Sharding: each stacked leaf gets the solo leaf's PartitionSpec with a
+leading ``None`` (tenants replicate across the mesh; the dict/data axes
+shard exactly as solo). Donation of the stacked state works unchanged —
+the stacked step's output state aliases its input buffers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_states(states: Sequence[Any]) -> Any:
+    """Stack N structurally-identical TrainStates along a new leading
+    tenant axis (leaf-wise ``jnp.stack``). Scalars (the step counter,
+    Adam's count) become ``[N]`` vectors — cohort members step in
+    lockstep but their values stay per-tenant."""
+    if not states:
+        raise ValueError("stack_states needs at least one state")
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+def unstack_state(stacked: Any, i: int) -> Any:
+    """Tenant ``i``'s solo TrainState view of a stacked state (leaf-wise
+    index on the leading axis) — used for per-tenant checkpointing and
+    retirement restacking."""
+    return jax.tree_util.tree_map(lambda a: a[i], stacked)
+
+
+@partial(jax.jit, static_argnums=1)
+def unstack_metrics(stacked: Any, n: int) -> list[Any]:
+    """Split a vmapped step's stacked metrics into per-tenant trees in
+    ONE dispatch. The naive per-member ``tree_map(a[i])`` costs
+    ``n × n_leaves`` host dispatches per round, which dominated the
+    fleet round at bench shapes; under jit the whole unstack is a single
+    program (cached per metric structure)."""
+    return [jax.tree_util.tree_map(lambda a, i=i: a[i], stacked)
+            for i in range(n)]
+
+
+def restack_without(stacked: Any, i: int) -> Any:
+    """Drop tenant ``i`` from a stacked state (retirement: the survivors'
+    cohort recompiles at N-1 but their per-tenant values carry over)."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.concatenate([a[:i], a[i + 1:]], axis=0), stacked
+    )
+
+
+def stacked_shardings(mesh: Mesh, solo_shardings: Any) -> Any:
+    """Shardings for a stacked TrainState: each solo leaf's PartitionSpec
+    with a leading ``None`` (tenant axis replicated, inner axes unchanged
+    — the dict axis still shards over 'model', quant_ef is rejected by
+    config validation before this can see one)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, P(None, *s.spec)),
+        solo_shardings,
+    )
+
+
+def vmap_step(body: Callable[..., Any]) -> Callable[..., Any]:
+    """Vectorize an ``l1_input`` step body over the tenant axis:
+    ``(stacked_state, batch, scale, l1_vec) -> (stacked_state, metrics)``
+    with batch/scale broadcast and state/l1 mapped. Metrics come back
+    with a leading ``[N]`` axis — one slot per tenant."""
+    return jax.vmap(body, in_axes=(0, None, None, 0), out_axes=(0, 0))
+
+
+def stacked_l1_vector(l1_coeffs: Sequence[float]) -> jax.Array:
+    """The cohort's per-tenant l1 base coefficients as a replicated f32
+    vector (the traced ``l1_base`` input of the ``l1_input`` step)."""
+    return jnp.asarray(list(l1_coeffs), jnp.float32)

@@ -79,10 +79,17 @@ class FleetPolicy:
 
     # -- the decision --------------------------------------------------
 
-    def choose(self, n_devices: int) -> MeshChoice:
-        """The mesh shape for ``n_devices`` total devices."""
+    def choose(self, n_devices: int, n_tenants: int = 1) -> MeshChoice:
+        """The mesh shape for ``n_devices`` total devices.
+
+        ``n_tenants`` (multi-tenant fleets, train/fleet.py): the number of
+        crosscoder tenants a step round trains. The tenant axis multiplies
+        the per-round compute and DP-sync bytes uniformly across candidate
+        splits — the RANKING is unchanged, but the modeled ``score_ms`` is
+        the true per-round cost, which is what autoscale dwell/idle-cost
+        comparisons consume."""
         if self.cfg.elastic_policy == "score":
-            ranked = self.rank(n_devices)
+            ranked = self.rank(n_devices, n_tenants)
             if ranked:
                 return ranked[0]
             print("[crosscoder_tpu] fleet: score policy produced no "
@@ -96,7 +103,7 @@ class FleetPolicy:
             )
         return MeshChoice(n_devices // m, m, None, {"policy": "fixed"})
 
-    def rank(self, n_devices: int) -> list[MeshChoice]:
+    def rank(self, n_devices: int, n_tenants: int = 1) -> list[MeshChoice]:
         """Score every candidate split, cheapest modeled step first.
 
         Per-candidate cost = compute + DP-sync wire time. One compile per
@@ -136,14 +143,18 @@ class FleetPolicy:
                 # the batch axis splits linearly across the data width
                 flops_dev = flops_ref * ref_data / max(1, n_data)
                 wire = comm_model.wire_bytes(profile, axis_size=n_data)
-                score_ms = 1000.0 * (
+                # tenant axis: N stacked/bucketed crosscoder steps per
+                # round, each paying the solo step's compute and grad sync
+                k = max(1, int(n_tenants))
+                score_ms = 1000.0 * k * (
                     flops_dev / PEAK_FLOPS
                     + wire / (comm_model.ICI_GBPS * 1e9)
                 )
                 choices.append(MeshChoice(
                     n_data, n_model, score_ms,
                     {"policy": "score", "flops_per_device": flops_dev,
-                     "wire_bytes": wire, "profiled_at": ref_data},
+                     "wire_bytes": wire, "profiled_at": ref_data,
+                     "n_tenants": k},
                 ))
             except Exception as e:
                 print(f"[crosscoder_tpu] fleet: scoring "

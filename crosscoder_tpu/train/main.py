@@ -71,7 +71,7 @@ def build_buffer(
     return buffer, cfg
 
 
-def main(argv: list[str] | None = None) -> Trainer:
+def main(argv: list[str] | None = None) -> Any:
     from crosscoder_tpu.parallel import multihost
     from crosscoder_tpu.utils import compile_cache
 
@@ -95,6 +95,29 @@ def main(argv: list[str] | None = None) -> Trainer:
               f"{(cfg.chaos or os.environ.get('CROSSCODER_CHAOS', ''))!r}",
               flush=True, file=sys.stderr)
     buffer, cfg = build_buffer(cfg, mesh, chaos=chaos)
+    if cfg.fleet == "on":
+        # fleet mode: N tenants in lockstep off the one buffer; the
+        # scheduler owns per-tenant checkpointers under
+        # <checkpoint_dir>/tenants/<name>/ (docs/RUNBOOK.md §7)
+        from crosscoder_tpu.obs.registry import MetricsRegistry
+        from crosscoder_tpu.train.fleet import FleetScheduler
+
+        fleet = FleetScheduler(
+            cfg, buffer=buffer, mesh=mesh,
+            logger=MetricsLogger(cfg) if multihost.is_primary() else None,
+            registry=MetricsRegistry(),
+        )
+        try:
+            if cfg.resume:
+                restored = fleet.restore_all()
+                print(f"[crosscoder_tpu] fleet resumed: {restored}",
+                      file=sys.stderr)
+            fleet.run()
+        finally:
+            fleet.quiesce()
+            if hasattr(buffer, "close"):
+                buffer.close()
+        return fleet
     trainer = Trainer(
         cfg, buffer, mesh=mesh,
         # logging is a process-0 singleton; the checkpointer exists on every

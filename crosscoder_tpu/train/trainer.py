@@ -53,11 +53,47 @@ from crosscoder_tpu.utils import pipeline
 from crosscoder_tpu.utils.logging import MetricsLogger, ResilienceCounters, source_tag
 
 
-def make_train_step(
-    cfg: CrossCoderConfig, mesh, tx, state_shardings, with_metrics: bool = True,
-    aux_on: bool = True, mask_refresh: bool = True,
+def variant_for_step(
+    cfg: CrossCoderConfig, host_step: int, full_metrics: bool = True,
+) -> tuple[bool, bool, bool]:
+    """The compiled-variant key ``(with_metrics, aux_on, mask_refresh)``
+    that step ``host_step`` of a run under ``cfg`` executes. The single
+    definition of the cadence logic — the Trainer's per-step variant
+    choice and the fleet scheduler's (train/fleet.py) lockstep tenant
+    steps both select through here, so they cannot drift."""
+    # aux_on=True is the canonical variant when AuxK is off or per-step
+    aux_on = (cfg.aux_k == 0 or cfg.aux_every <= 1
+              or host_step % cfg.aux_every == 0)
+    # mask_refresh=True is canonical when masks are per-step
+    # (aux_mask_every == 1, the default) or no mask exists at all;
+    # cached-mask runs refresh at the cadence and reuse in between
+    cached_mask = ((cfg.aux_k > 0 or cfg.resample_every > 0)
+                   and cfg.aux_mask_every != 1)
+    mask_refresh = (not cached_mask
+                    or host_step % cfg.aux_mask_cadence == 0)
+    return (full_metrics, aux_on, mask_refresh)
+
+
+def make_step_body(
+    cfg: CrossCoderConfig, mesh, tx, with_metrics: bool = True,
+    aux_on: bool = True, mask_refresh: bool = True, l1_input: bool = False,
 ) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
-    """Build the compiled train step for a given mesh/optimizer.
+    """The UNJITTED train-step body :func:`make_train_step` compiles.
+
+    Split out so the fleet scheduler (train/fleet.py) can ``jax.vmap`` the
+    same body over a stacked cohort of shape-identical tenants before
+    jitting — one compile, one dispatch for the whole cohort — while the
+    solo Trainer's trace stays byte-identical (it jits exactly this
+    function, same jaxpr as before the split).
+
+    ``l1_input=True`` swaps the baked ``cfg.l1_coeff`` for a traced
+    scalar: the returned function takes ``(state, batch, scale, l1_base)``
+    and computes ``l1_coeff = l1_base * warmup_ramp(state.step)`` — the
+    same f32 multiply :func:`schedules.l1_coeff_schedule` performs with
+    the constant, so a tenant's loss trajectory is bitwise the solo run's.
+    That lets one vmapped cohort sweep l1 without recompiling per value.
+    Incompatible with ``cfg.quant_grads`` (the shard_map path bakes its
+    spec list; config validation rejects fleet+quant_grads anyway).
 
     The returned function is ``step_fn(state, batch, scale)``: ``batch`` may
     be fp32 rows already normalized (``scale`` of ones), or — the TPU fast
@@ -170,9 +206,9 @@ def make_train_step(
         new_state = TrainState(new_params, new_opt, state.step + 1, new_aux)
         return new_state, metrics
 
-    def step_fn(state: TrainState, batch: jax.Array, scale: jax.Array):
+    def _dense_step(state: TrainState, batch: jax.Array, scale: jax.Array,
+                    l1_coeff: jax.Array):
         x = batch.astype(jnp.float32) * scale[None, :, None]
-        l1_coeff = l1_fn(state.step)
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
         kwargs: dict[str, Any] = {}
         if cfg.l0_coeff > 0:
@@ -208,6 +244,15 @@ def make_train_step(
                 losses.explained_variance_per_source, axis=-1
             )
         return _finish(state, grads, l1_coeff, dead, None, loss, mets)
+
+    def step_fn(state: TrainState, batch: jax.Array, scale: jax.Array):
+        return _dense_step(state, batch, scale, l1_fn(state.step))
+
+    def step_fn_l1(state: TrainState, batch: jax.Array, scale: jax.Array,
+                   l1_base: jax.Array):
+        # same multiply l1_coeff_schedule performs, with the constant
+        # replaced by a traced scalar — per-tenant bitwise parity
+        return _dense_step(state, batch, scale, l1_base * warm_fn(state.step))
 
     def quant_step_fn(state: TrainState, batch: jax.Array, scale: jax.Array):
         from jax.sharding import PartitionSpec as P
@@ -279,10 +324,32 @@ def make_train_step(
             mets["fired"] = None
         return _finish(state, grads, l1_coeff, dead, new_ef, loss, mets)
 
+    if l1_input:
+        if use_qgrads:
+            raise ValueError(
+                "l1_input (fleet stacked step) is incompatible with "
+                "quant_grads' shard_map path"
+            )
+        return step_fn_l1
+    return quant_step_fn if use_qgrads else step_fn
+
+
+def make_train_step(
+    cfg: CrossCoderConfig, mesh, tx, state_shardings, with_metrics: bool = True,
+    aux_on: bool = True, mask_refresh: bool = True,
+) -> Callable[..., tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the compiled train step for a given mesh/optimizer: the
+    :func:`make_step_body` body jitted with donated state and the mesh's
+    batch/state shardings (see that function's docstring for the step's
+    semantics and the variant knobs)."""
+    fn = make_step_body(
+        cfg, mesh, tx, with_metrics=with_metrics, aux_on=aux_on,
+        mask_refresh=mask_refresh,
+    )
     batch_sh = mesh_lib.batch_sharding(mesh)
     replicated = NamedSharding(mesh, PartitionSpec())
     return jax.jit(
-        quant_step_fn if use_qgrads else step_fn,
+        fn,
         in_shardings=(state_shardings, batch_sh, replicated),
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
@@ -705,23 +772,12 @@ class Trainer:
         returned dict. ``train()`` uses it off log-steps.
         """
         cfg = self.cfg
-        # aux_on=True is the canonical variant when AuxK is off or per-step
-        aux_on = (cfg.aux_k == 0 or cfg.aux_every <= 1
-                  or self._host_step % cfg.aux_every == 0)
-        # mask_refresh=True is canonical when masks are per-step
-        # (aux_mask_every == 1, the default) or no mask exists at all;
-        # cached-mask runs refresh at the cadence and reuse in between
-        cached_mask = ((cfg.aux_k > 0 or cfg.resample_every > 0)
-                       and cfg.aux_mask_every != 1)
-        mask_refresh = (not cached_mask
-                        or self._host_step % cfg.aux_mask_cadence == 0)
-        key = (full_metrics, aux_on, mask_refresh)
+        key = variant_for_step(cfg, self._host_step, full_metrics)
         fn = self._step_fns.get(key)
         if fn is None:
             fn = self._step_fns[key] = self._wrap_step(key, make_train_step(
                 cfg, self.mesh, self._tx, self._state_shardings,
-                with_metrics=full_metrics, aux_on=aux_on,
-                mask_refresh=mask_refresh,
+                with_metrics=key[0], aux_on=key[1], mask_refresh=key[2],
             ))
         if self._obs is not None:
             # refill_wait: the train loop blocked on batch production —

@@ -24,7 +24,7 @@ from typing import Any
 
 
 def variant_key(metrics: bool, aux: bool, refresh: bool, *,
-                enc: str = "dense") -> str:
+                enc: str = "dense", tenant: str = "") -> str:
     """Canonical compile-event key for one train-step variant.
 
     ``(metrics, aux, refresh)`` is the Trainer's compiled-variant cache
@@ -32,12 +32,17 @@ def variant_key(metrics: bool, aux: bool, refresh: bool, *,
     variant ("dense", "fused", "fused-int8" — cfg.fused_encoder /
     cfg.quant_encoder resolved at build time), so compile telemetry and
     the HLO cost-analysis report distinguish a fused step from a dense
-    one instead of aliasing them under one label. Every writer of a
-    step-variant key goes through here — the single place the key
-    format lives.
+    one instead of aliasing them under one label. ``tenant`` is the
+    fleet scheduler's compile-bucket tag (train/fleet.py): a stacked
+    cohort or a heterogeneous tenant signature appends its bucket name
+    so per-tenant compile events stay distinguishable; solo-trainer
+    keys (``tenant=""``) are byte-stable with the pre-fleet format.
+    Every writer of a step-variant key goes through here — the single
+    place the key format lives.
     """
+    tag = f", tenant={tenant}" if tenant else ""
     return (f"train_step(metrics={metrics}, aux={aux}, "
-            f"refresh={refresh}, enc={enc})")
+            f"refresh={refresh}, enc={enc}{tag})")
 
 
 def enable(cache_dir: str | None = None) -> str | None:

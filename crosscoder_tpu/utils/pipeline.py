@@ -159,30 +159,74 @@ class QuantumDispatcher:
     as a dead daemon). Used by the buffer at cycle completion and before
     any state mutation that invalidates in-flight work (restore, forced
     refresh, close).
+
+    FAIRNESS UNDER FAN-OUT (multi-tenant serving, train/fleet.py): extra
+    consumers may register their own pumps via :meth:`add_channel` and
+    post credit with ``submit(credit, channel=...)``. With one channel
+    (every pre-fleet caller) the drain loop keeps the exact historical
+    semantics — grab ALL accumulated credit, one pump call. With several,
+    it services channels ROUND-ROBIN in bounded chunks of ``quantum``
+    credits, so one slow consumer's backlog cannot starve the shared
+    refill pump: the refill channel gets a turn after at most
+    ``(n_channels - 1) * quantum`` foreign credits, regardless of how
+    deep the slow channel's queue runs.
     """
 
+    #: per-turn credit chunk per channel in multi-channel round-robin
+    QUANTUM = 4
+
     def __init__(self, pump: Callable[[int], None], name: str = "refill-dispatch") -> None:
-        self._pump = pump
         self._cond = threading.Condition()
-        self._credit = 0
+        # channel key None is the primary (legacy single-channel) pump
+        self._pumps: dict[str | None, Callable[[int], None]] = {None: pump}
+        self._credits: dict[str | None, int] = {None: 0}
+        self._order: list[str | None] = [None]
+        self._rr = 0
         self._busy = False
         self._closed = False
         self._error: BaseException | None = None
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
 
+    def add_channel(self, name: str, pump: Callable[[int], None]) -> None:
+        """Register a named consumer channel with its own pump."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("QuantumDispatcher is closed")
+            if name is None or name in self._pumps:
+                raise ValueError(f"channel {name!r} invalid or already registered")
+            self._pumps[name] = pump
+            self._credits[name] = 0
+            self._order.append(name)
+
+    def _take_locked(self) -> tuple[str | None, int]:
+        """Pick the next (channel, credit) to service; caller holds the
+        lock and has established that some credit exists."""
+        if len(self._order) == 1:
+            # single channel: grab-all, exactly the pre-channel behavior
+            credit, self._credits[None] = self._credits[None], 0
+            return None, credit
+        for _ in range(len(self._order)):
+            ch = self._order[self._rr % len(self._order)]
+            self._rr += 1
+            if self._credits[ch] > 0:
+                credit = min(self._credits[ch], self.QUANTUM)
+                self._credits[ch] -= credit
+                return ch, credit
+        raise AssertionError("unreachable: credit vanished under the lock")
+
     def _run(self) -> None:
         while True:
             with self._cond:
-                while self._credit == 0 and not self._closed:
+                while not any(self._credits.values()) and not self._closed:
                     self._cond.wait()
-                if self._closed and self._credit == 0:
+                if self._closed and not any(self._credits.values()):
                     return
-                credit, self._credit = self._credit, 0
+                ch, credit = self._take_locked()
                 self._busy = True
             try:
                 if self._error is None:
-                    self._pump(credit)
+                    self._pumps[ch](credit)
             except BaseException as e:  # noqa: BLE001 — re-raised in drain()
                 with self._cond:
                     self._error = e
@@ -191,20 +235,23 @@ class QuantumDispatcher:
                     self._busy = False
                     self._cond.notify_all()
 
-    def submit(self, credit: int) -> None:
+    def submit(self, credit: int, channel: str | None = None) -> None:
         """Post dispatch credit; returns immediately."""
         if credit <= 0:
             return
         with self._cond:
             if self._closed:
                 raise RuntimeError("QuantumDispatcher is closed")
-            self._credit += credit
+            if channel not in self._credits:
+                raise ValueError(f"unknown channel {channel!r}")
+            self._credits[channel] += credit
             self._cond.notify_all()
 
     def drain(self) -> None:
-        """Block until idle (all credit spent); re-raise any pump error."""
+        """Block until idle (all credit spent, every channel); re-raise
+        any pump error."""
         with self._cond:
-            while self._credit > 0 or self._busy:
+            while any(self._credits.values()) or self._busy:
                 self._cond.wait()
             if self._error is not None:
                 err, self._error = self._error, None

@@ -31,4 +31,10 @@ env JAX_PLATFORMS=cpu python -m crosscoder_tpu.resilience.elastic_drill \
 # "Elastic scale-up"; exit 0 iff bitwise_equal AND joiner_equal)
 env JAX_PLATFORMS=cpu python -m crosscoder_tpu.resilience.elastic_drill \
     --mode autoscale || exit 1
+# fleet smoke: a stacked 2-tenant cohort plus one bucketed tenant train in
+# lockstep off ONE stream, every trajectory bitwise the solo run
+# (docs/SCALING.md "Fleet amortization")
+env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet.py::test_fleet_parity_stacked_and_bucketed \
+    -q -p no:cacheprovider || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

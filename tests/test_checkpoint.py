@@ -313,3 +313,40 @@ def test_bf16_master_checkpoint_roundtrip(tmp_path):
                     jax.tree_util.tree_leaves(state)):
         np.testing.assert_array_equal(np.asarray(a, np.float32),
                                       np.asarray(b, np.float32))
+
+
+def test_keep_saves_scoped_per_tenant(tmp_path):
+    """Retention prunes per tenant SUBDIRECTORY, not globally: a 4-tenant
+    fleet with keep_saves=3 and 5 saves each keeps exactly 3 complete
+    saves under every <ckpt_dir>/tenants/<name>/ — interleaved saves from
+    siblings must never count against (or reap) each other's budget."""
+    from crosscoder_tpu.train import schedules
+    from crosscoder_tpu.train.state import init_train_state, make_optimizer
+
+    cfg = tiny_cfg(tmp_path, keep_saves=3)
+    tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
+    state = init_train_state(jax.random.key(0), cfg, tx)
+    names = ["a", "b", "c", "d"]
+    cks = {n: Checkpointer(str(tmp_path), cfg=cfg, tenant=n) for n in names}
+    for _ in range(5):
+        for n in names:                 # interleave, the fleet save order
+            cks[n].save(state, cfg)
+    for n in names:
+        vdir = tmp_path / "tenants" / n / "version_0"
+        assert Checkpointer.complete_saves(vdir) == [2, 3, 4], n
+        # each tenant still restores from ITS newest survivor
+        restored, meta = Checkpointer(str(tmp_path), cfg=cfg,
+                                      tenant=n).restore(cfg, tx)
+        for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                        jax.tree_util.tree_leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tenant_name_validation(tmp_path):
+    cfg = tiny_cfg(tmp_path)
+    for bad in ("", "a/b", ".", ".."):
+        try:
+            Checkpointer(str(tmp_path), cfg=cfg, tenant=bad)
+        except ValueError:
+            continue
+        raise AssertionError(f"tenant name {bad!r} accepted")

@@ -1,0 +1,265 @@
+"""Multi-tenant fleet scheduler (cfg.fleet=on; docs/SCALING.md "Fleet
+amortization"; train/fleet.py):
+
+- per-tenant loss trajectories bitwise equal to SOLO runs over the same
+  stream at the same seed — for both stacked (vmapped cohort) and
+  bucketed (own compiled variant) tenants;
+- one real gather per lockstep round: the buffer fan-out protocol adds
+  ZERO host↔device transfers over a single consumer (the monkeypatched
+  device_put/get harness from tests/test_refill_overlap.py, on a real
+  PairedActivationBuffer);
+- admission and retirement mid-run (a late tenant equals a solo run
+  launched at the live stream head; survivors stay bitwise-solo);
+- restore-all-tenants after a simulated preemption: the resumed fleet's
+  trajectories bitwise-continue an uninterrupted run.
+
+All CPU, tier-1; the parity test doubles as the scripts/tier1.sh fleet
+smoke.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.data.buffer import PairedActivationBuffer
+from crosscoder_tpu.data.synthetic import SyntheticActivationSource
+from crosscoder_tpu.models import lm
+from crosscoder_tpu.obs.registry import MetricsRegistry
+from crosscoder_tpu.train.fleet import (FleetScheduler, TenantSpec,
+                                        parse_tenants, tenant_config)
+from crosscoder_tpu.train.trainer import Trainer
+
+
+def base_cfg(**kw):
+    base = dict(
+        d_in=16, dict_size=64, batch_size=64, num_tokens=64 * 1000,
+        enc_dtype="fp32", log_backend="null", seed=11,
+    )
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+def fleet_cfg(tenants, **kw):
+    return base_cfg(fleet="on", fleet_tenants=tenants, **kw)
+
+
+def solo_losses(overrides, n_steps, skip_rounds=0):
+    """Loss trajectory of a SOLO trainer carrying the tenant's overrides
+    over the fleet's shared stream (base-seed synthetic source) — the
+    bitwise baseline every fleet tenant must reproduce. ``skip_rounds``
+    pre-advances the stream, modeling a tenant admitted mid-run."""
+    base = base_cfg()
+    buf = SyntheticActivationSource(base)
+    for _ in range(skip_rounds):
+        buf.next()
+    tr = Trainer(dataclasses.replace(base, **overrides), buf)
+    return [float(jax.device_get(tr.step()["loss"])) for _ in range(n_steps)]
+
+
+def fleet_losses(fl, n_rounds):
+    """Drive ``n_rounds`` lockstep rounds; per-tenant loss lists."""
+    out: dict[str, list[float]] = {}
+    for _ in range(n_rounds):
+        mets = fl.step_all()
+        for name, md in mets.items():
+            out.setdefault(name, []).append(float(jax.device_get(md["loss"])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity vs solo — stacked cohort AND compiled bucket
+
+
+def test_fleet_parity_stacked_and_bucketed():
+    """a+b differ only in seed/l1_coeff → one vmapped cohort; w differs in
+    dict_size → its own bucket. Every trajectory must be BITWISE the solo
+    run (also the tier-1 fleet smoke — scripts/tier1.sh runs this test)."""
+    fl = FleetScheduler(
+        fleet_cfg("a:seed=1;b:seed=2,l1_coeff=0.05;w:seed=1,dict_size=128"),
+        checkpoint=False,
+    )
+    assert len(fl._cohorts) == 1 and len(fl._buckets) == 1
+    got = fleet_losses(fl, 5)
+    for name, ov in (
+        ("a", dict(seed=1)),
+        ("b", dict(seed=2, l1_coeff=0.05)),
+        ("w", dict(seed=1, dict_size=128)),
+    ):
+        assert got[name] == solo_losses(ov, 5), name
+
+
+def test_tenant_config_pins_stream_shape():
+    base = fleet_cfg("a")
+    with pytest.raises(ValueError, match="pinned"):
+        tenant_config(base, TenantSpec("x", {"batch_size": 32}))
+    with pytest.raises(ValueError, match="quant_grads"):
+        tenant_config(base, TenantSpec("x", {"quant_grads": True}))
+    specs = parse_tenants("a:seed=1,l1_coeff=0.02; b")
+    assert specs[0].overrides == {"seed": 1, "l1_coeff": 0.02}
+    assert specs[1] == TenantSpec("b", {})
+
+
+# ---------------------------------------------------------------------------
+# single-gather fan-out: zero extra transfers on the real buffer
+
+SEQ = 17
+HP = "blocks.2.hook_resid_pre"
+
+
+@pytest.fixture(scope="module")
+def lm_pair():
+    cfg = lm.LMConfig.tiny()
+    return cfg, [lm.init_params(jax.random.key(0), cfg),
+                 lm.init_params(jax.random.key(1), cfg)]
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(7)
+    return rng.integers(0, 257, size=(256, SEQ), dtype=np.int64)
+
+
+def buf_cfg(**kw):
+    base = dict(
+        batch_size=32, buffer_mult=32, seq_len=SEQ, d_in=32, n_models=2,
+        model_batch_size=4, norm_calib_batches=2, hook_point=HP, seed=3,
+    )
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+def test_fanout_single_gather_no_extra_transfers(lm_pair, tokens, monkeypatch):
+    """Serving 3 fan-out consumers for 6 rounds performs EXACTLY the same
+    number of device_put/device_get calls as one solo consumer — the
+    first cursor at a position pays the gather, peers read the cache —
+    and every consumer sees the byte-identical solo stream."""
+    lm_cfg, params = lm_pair
+    real_put, real_get = jax.device_put, jax.device_get
+
+    def run(consumers):
+        put, get = [], []
+        monkeypatch.setattr(jax, "device_put",
+                            lambda *a, **k: (put.append(1), real_put(*a, **k))[1])
+        monkeypatch.setattr(jax, "device_get",
+                            lambda x: (get.append(1), real_get(x))[1])
+        try:
+            b = PairedActivationBuffer(buf_cfg(), lm_cfg, params, tokens)
+            for n in consumers:
+                b.attach_consumer(n)
+            rounds = []
+            for _ in range(6):
+                if consumers:
+                    batches = [np.asarray(b.next_raw_for(n)) for n in consumers]
+                    for peer in batches[1:]:
+                        np.testing.assert_array_equal(peer, batches[0])
+                    rounds.append(batches[0])
+                else:
+                    rounds.append(np.asarray(b.next_raw()))
+            b.close()
+        finally:
+            monkeypatch.setattr(jax, "device_put", real_put)
+            monkeypatch.setattr(jax, "device_get", real_get)
+        return (len(put), len(get)), rounds
+
+    solo_counts, solo_stream = run([])
+    fan_counts, fan_stream = run(["a", "b", "c"])
+    assert fan_counts == solo_counts, (fan_counts, solo_counts)
+    assert solo_counts[1] > 0           # the counter saw the chunk fetches
+    for i, (fan, solo) in enumerate(zip(fan_stream, solo_stream)):
+        np.testing.assert_array_equal(fan, solo, err_msg=f"round {i}")
+
+
+def test_fanout_lockstep_enforced():
+    """A consumer more than one position behind the head (peer cache
+    already advanced past it) is a protocol violation, not silent skew."""
+    src = SyntheticActivationSource(base_cfg())
+    src.attach_consumer("fast")
+    src.attach_consumer("slow")
+    src.next_for("fast")
+    src.next_for("slow")      # both at 1 — cache at 0
+    src.next_for("fast")      # fast at 2 — cache moved to 1
+    src.next_for("fast")      # fast at 3 — cache at 2, slow (1) stranded
+    with pytest.raises(RuntimeError, match="lockstep"):
+        src.next_for("slow")
+
+
+def test_fleet_counts_one_h2d_per_round():
+    reg = MetricsRegistry()
+    fl = FleetScheduler(fleet_cfg("a:seed=1;b:seed=2;c:seed=3"),
+                        checkpoint=False, registry=reg)
+    fleet_losses(fl, 4)
+    # one upload per ROUND, not per tenant — the amortization itself
+    assert reg.get_count("comm/h2d_transfers") == 4
+    assert reg.get_count("tenant/admissions") == 3
+
+
+# ---------------------------------------------------------------------------
+# admission / retirement mid-run
+
+
+def test_admission_and_retirement_mid_run():
+    reg = MetricsRegistry()
+    fl = FleetScheduler(fleet_cfg("a:seed=1;b:seed=2"),
+                        checkpoint=False, registry=reg)
+    traj = fleet_losses(fl, 3)
+    fl.admit(TenantSpec("late", {"seed": 7, "dict_size": 128}))
+    assert "late" in fl.active() and len(fl._buckets) == 1
+    mid = fleet_losses(fl, 3)
+    # a late tenant equals a solo run LAUNCHED at the live stream head
+    assert mid["late"] == solo_losses(dict(seed=7, dict_size=128), 3,
+                                      skip_rounds=3)
+    fl.retire("b", save=False)
+    assert fl.active() == ["a", "late"]
+    assert not fl._buckets or fl._buckets[0].tenant.name == "late"
+    tail = fleet_losses(fl, 3)
+    assert "b" not in tail
+    # the surviving cohort member is untouched by churn around it:
+    # its full 9-round trajectory is still bitwise the solo run
+    full_a = traj["a"] + mid["a"] + tail["a"]
+    assert full_a == solo_losses(dict(seed=1), 9)
+    assert reg.get_count("tenant/admissions") == 3
+    assert reg.get_count("tenant/retirements") == 1
+
+
+def test_bucket_cap_rejects_then_frees():
+    fl = FleetScheduler(
+        fleet_cfg("a:seed=1,dict_size=128", fleet_max_buckets=1),
+        checkpoint=False,
+    )
+    with pytest.raises(ValueError, match="fleet_max_buckets"):
+        fl.admit(TenantSpec("b", {"dict_size": 96}))
+    assert fl.active() == ["a"]          # failed admission rolled back
+    fl.retire("a", save=False)           # frees the only bucket slot
+    fl.admit(TenantSpec("b", {"dict_size": 96}))
+    assert fl.active() == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# restore-all after a simulated preemption
+
+
+def test_restore_all_after_preemption(tmp_path):
+    spec = "a:seed=1;b:seed=2;w:seed=3,dict_size=128"
+
+    ref = fleet_losses(
+        FleetScheduler(fleet_cfg(spec), checkpoint=False), 8,
+    )
+
+    fl = FleetScheduler(fleet_cfg(spec, checkpoint_dir=str(tmp_path)))
+    head = fleet_losses(fl, 4)
+    fl.save_all()
+    fl.quiesce()
+    del fl                               # the preemption
+
+    fl2 = FleetScheduler(fleet_cfg(spec, checkpoint_dir=str(tmp_path)))
+    restored = fl2.restore_all()
+    assert restored == {"a": 4, "b": 4, "w": 4}
+    assert fl2.buffer.counter == 4       # shared stream rewound with them
+    tail = fleet_losses(fl2, 4)
+    for name in ("a", "b", "w"):
+        assert head[name] == ref[name][:4], name
+        assert tail[name] == ref[name][4:], name

@@ -211,3 +211,74 @@ def test_dispatcher_zero_credit_is_noop():
     d.drain()
     assert calls == []
     d.close()
+
+
+def test_dispatcher_round_robin_bounds_slow_channel():
+    """Fan-out fairness (train/fleet.py): a slow consumer channel with a
+    deep backlog cannot starve the shared refill pump — round-robin
+    servicing in chunks of QUANTUM credits gets the refill channel a turn
+    after at most QUANTUM foreign credits, long before the slow backlog
+    drains."""
+    events: list[tuple[str, int]] = []
+    slow_started = threading.Event()
+    refill_posted = threading.Event()
+
+    def refill_pump(credit):
+        events.append(("refill", credit))
+
+    def slow_pump(credit):
+        events.append(("slow", credit))
+        slow_started.set()
+        refill_posted.wait(timeout=5)       # a genuinely slow consumer
+
+    d = pipeline.QuantumDispatcher(refill_pump)
+    d.add_channel("slow", slow_pump)
+    d.submit(40, channel="slow")            # deep backlog, posted first
+    assert slow_started.wait(timeout=5)
+    d.submit(4)                             # refill credit arrives late
+    refill_posted.set()
+    d.drain()
+    first_refill = next(i for i, (ch, _) in enumerate(events)
+                        if ch == "refill")
+    slow_before = sum(c for ch, c in events[:first_refill] if ch == "slow")
+    # bound: the chunk in flight when refill credit landed + at most one
+    # more turn of the rotation
+    assert slow_before <= 2 * pipeline.QuantumDispatcher.QUANTUM, events
+    assert sum(c for ch, c in events if ch == "slow") == 40
+    assert sum(c for ch, c in events if ch == "refill") == 4
+    d.close()
+
+
+def test_dispatcher_single_channel_keeps_grab_all():
+    """With only the primary channel registered, the pre-fleet semantics
+    hold exactly: ALL accumulated credit is spent in one pump call."""
+    calls = []
+    release = threading.Event()
+    first = threading.Event()
+
+    def pump(credit):
+        calls.append(credit)
+        first.set()
+        release.wait(timeout=5)
+
+    d = pipeline.QuantumDispatcher(pump)
+    d.submit(3)
+    assert first.wait(timeout=5)
+    for c in (2, 5, 1):                     # accumulate while pump busy
+        d.submit(c)
+    release.set()
+    d.drain()
+    assert calls == [3, 8]                  # one grab-all, no quantum split
+    d.close()
+
+
+def test_dispatcher_channel_validation():
+    d = pipeline.QuantumDispatcher(lambda credit: None)
+    with pytest.raises(ValueError, match="unknown channel"):
+        d.submit(1, channel="ghost")
+    d.add_channel("t", lambda credit: None)
+    with pytest.raises(ValueError, match="already registered"):
+        d.add_channel("t", lambda credit: None)
+    d.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        d.add_channel("late", lambda credit: None)
