@@ -1,6 +1,6 @@
 """Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Eleven sections (env ``BENCH_SECTIONS``, default all; progress on stderr).
+Twelve sections (env ``BENCH_SECTIONS``, default all; progress on stderr).
 Output contract: stdout carries exactly ONE machine-parseable JSON line,
 guaranteed last and guaranteed **compact** (≤2 KB: headline, per-section
 key numbers, gate booleans) — the driver truncates the line at 2000
@@ -51,6 +51,11 @@ to stderr for the whole run:
   kills one host mid-run, the survivor re-meshes and
   restore-with-respecs; reports ``remesh_ms`` (detect → resumed wall
   time) and the bitwise-equal recovery gate.
+- **serve**: the online model-diffing request path (docs/SERVING.md) —
+  per-request p50/p99/max latency at batch 1/8/64 through the
+  continuous-batched harvest→encode loop, saturated req/s, the
+  p99 ≤ 3×p50 SLO gate at batch 8, and the zero-compiles-after-warmup
+  assertion (AOT bucket reuse).
 
 Headline metric = e2e acts/sec/chip. ``vs_baseline`` divides by an
 analytic single-A100 torch estimate, documented here so it stays fixed:
@@ -64,8 +69,8 @@ per-chip parity — BASELINE.json.)
 
 Env knobs (debug/CI only): BENCH_SECTIONS, BENCH_DICT, BENCH_BATCH,
 BENCH_STEPS, BENCH_CPU=1, BENCH_MASTER_DTYPE, BENCH_QUANT=1 (e2e with
-the int8 replay store), QUANT_RELMSE_BOUND, BENCH_ARTIFACT (detail
-file path).
+the int8 replay store), QUANT_RELMSE_BOUND, BENCH_SERVE_REPS,
+BENCH_ARTIFACT (detail file path).
 """
 
 from __future__ import annotations
@@ -1312,6 +1317,48 @@ def section_fleet() -> dict:
     return out
 
 
+def section_serve() -> dict:
+    """The serving path's latency SLO (docs/SERVING.md): per-request
+    p50/p99/max through the continuous-batched harvest→encode loop at
+    batch 1/8/64, saturated req/s, and the two gates the path promises —
+    p99 <= 3*p50 at batch 8 (tail discipline: with AOT buckets and
+    deadline flushes there is no legitimate source of a fat tail at a
+    fixed batch) and ZERO compiles after warmup (every request hits a
+    prewarmed bucket executable). Tiny-LM shapes: the section measures
+    the engine's batching/dispatch machinery, which is shape-independent;
+    the harvest cost model for real shapes is section ``harvest``."""
+    from crosscoder_tpu.serve import smoke as serve_smoke
+
+    tiny = os.environ.get("BENCH_TINY") == "1"    # CI/debug only
+    reps = int(os.environ.get("BENCH_SERVE_REPS", 8 if tiny else 30))
+    t0 = time.perf_counter()
+    eng, cfg, lm_cfg, _lm_params, _cc_params = serve_smoke.build_engine(
+        serve_max_batch=64)
+    warm_compiles = eng.warmup()
+    warmup_s = time.perf_counter() - t0
+    log(f"[serve] warmup: {warm_compiles} executables over "
+        f"{len(eng.buckets)} buckets in {warmup_s:.1f}s")
+
+    legs = [serve_smoke.latency_leg(eng, cfg, lm_cfg, b, reps)
+            for b in (1, 8, 64)]
+    at8 = next(l for l in legs if l["batch"] == 8)
+    out = {
+        "batches": {str(l["batch"]): {k: l[k] for k in
+                                      ("p50_ms", "p99_ms", "max_ms")}
+                    for l in legs},
+        "req_s_saturated": legs[-1]["req_s"],   # batch-64 = packed planes
+        "p50_ms_b8": at8["p50_ms"],
+        "p99_ms_b8": at8["p99_ms"],
+        "serve_gate_ok": at8["p99_ms"] <= 3.0 * at8["p50_ms"],
+        "warmup_s": round(warmup_s, 1),
+        "warmup_compiles": warm_compiles,
+        "compiles_after_warmup": eng.compiles_after_warmup,
+        "zero_compiles_ok": eng.compiles_after_warmup == 0,
+    }
+    log(f"[serve] {out}")
+    return out
+
+
 # stdout-summary projection: per section, the fields worth the 2 KB line
 _SUMMARY_KEYS = {
     "step": ("acts_per_sec_chip", "vs_a100_step"),
@@ -1326,12 +1373,15 @@ _SUMMARY_KEYS = {
                 "autoscale_cycle_s"),
     "fleet": ("agg_acts_per_sec_chip", "solo_agg_acts_per_sec_chip",
               "harvest_amortization", "fleet_gate_ok"),
+    "serve": ("p50_ms_b8", "p99_ms_b8", "req_s_saturated",
+              "serve_gate_ok", "zero_compiles_ok"),
 }
 _GATES = (("refill_overlap", "gate_ok"), ("quant", "quality_gate_ok"),
           ("obs", "overhead_gate_ok"), ("e2e", "loss_finite"),
           ("elastic", "bitwise_equal"),
           ("elastic", "autoscale_bitwise_equal"),
-          ("fleet", "fleet_gate_ok"))
+          ("fleet", "fleet_gate_ok"),
+          ("serve", "serve_gate_ok"), ("serve", "zero_compiles_ok"))
 
 
 def _compact(headline: dict, results: dict) -> dict:
@@ -1427,7 +1477,7 @@ def _run_sections() -> dict:
     sections = os.environ.get(
         "BENCH_SECTIONS",
         "step,matrix,configs,e2e,refill_overlap,harvest,quant,obs,dash,"
-        "elastic,fleet"
+        "elastic,fleet,serve"
     ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
@@ -1438,7 +1488,8 @@ def _run_sections() -> dict:
                      ("quant", section_quant), ("obs", section_obs),
                      ("dash", section_dash),
                      ("elastic", section_elastic),
-                     ("fleet", section_fleet)):
+                     ("fleet", section_fleet),
+                     ("serve", section_serve)):
         if name not in sections:
             continue
         try:

@@ -51,7 +51,8 @@ GATE_RE = re.compile(r"^CROSSCODER_[A-Z0-9_]+_PALLAS$")
 
 # metric-key surface (kept in lockstep with the docstring of
 # scripts/check_metric_keys.py, which re-exports these)
-NAMESPACES = ("resilience/", "perf/", "comm/", "harvest/", "tenant/")
+NAMESPACES = ("resilience/", "perf/", "comm/", "harvest/", "tenant/",
+              "serve/")
 REFERENCE_KEYS = {
     "loss", "l2_loss", "l1_loss", "l0_loss", "l1_coeff", "lr",
     "explained_variance",

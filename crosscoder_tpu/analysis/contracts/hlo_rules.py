@@ -68,6 +68,8 @@ class VariantMeta:
     n_donated_leaves: int = 0
     quant_off: bool = True                  # no int8 may appear
     forbid_dense_shape: tuple[int, int] | None = None   # (B, dict) if fused
+    serve_step: bool = False                # a serve-plane encode lowering,
+                                            # not a train step (own rules)
 
 
 @dataclass
@@ -215,11 +217,13 @@ KNOB_OFF_LATTICE: tuple[tuple[str, dict[str, Any]], ...] = (
                           elastic_grow_debounce=4, elastic_policy="score")),
     ("fleet", dict(fleet="on", fleet_tenants="a:seed=1;b:seed=2",
                    fleet_max_buckets=4, checkpoint_dir="/tmp/ckpt")),
+    ("serve", dict(serve="on", serve_max_batch=8, serve_max_wait_ms=2.0,
+                   serve_queue=32, serve_shed_ms=50.0)),
     ("all_knobs", dict(quant_buffer=True, quant_block=8, obs="on",
                        harvest_runtime="paged", page_size=16, seq_len=1024,
                        guard_loss=True, log_backend="jsonl",
                        refill_overlap="on", refill_dispatch_batch=8,
-                       elastic="on", elastic_grow="on",
+                       elastic="on", elastic_grow="on", serve="on",
                        checkpoint_dir="/tmp/ckpt")),
 )
 
@@ -269,6 +273,19 @@ def build_step_context(full: bool = True) -> StepContext:
                        **_FUSED_SHAPE)
             add("topk:fused_live", cfg,
                 forbid_dense_shape=(cfg.batch_size, cfg.dict_size))
+            # the serve plane's device program: encode→TopK→diff on captured
+            # hooks with the fused kernel live — like the train step it must
+            # never materialize the [B, dict] pre-act matrix
+            # (hlo-serve-no-dense-preacts)
+            from crosscoder_tpu.serve import step as serve_step
+
+            scfg = _cfg(activation="topk", fused_encoder="on",
+                        sparse_bwd="on", serve="on", **_FUSED_SHAPE)
+            ctx.texts["serve:encode_fused"] = serve_step.lower_encode_text(scfg)
+            ctx.meta["serve:encode_fused"] = VariantMeta(
+                serve_step=True,
+                forbid_dense_shape=(scfg.batch_size, scfg.dict_size))
+            ctx.jaxpr_consts["serve:encode_fused"] = []
     return ctx
 
 
@@ -378,6 +395,27 @@ def _check_fleet_off(ctx: StepContext) -> list[Finding]:
     return out
 
 
+def _check_serve_off(ctx: StepContext) -> list[Finding]:
+    """The serving path (``cfg.serve`` and its batching/queue/shed knobs)
+    is a separate request loop AROUND the models, never a train-step
+    change: the engine reuses the paged harvest forward and the encoder
+    the trainer already compiles, so with every serve knob set the TRAIN
+    STEP must lower byte-identically to the bare baseline
+    (docs/SERVING.md "Zero-cost off"). Own rule, own mutation self-test,
+    own name in the report."""
+    out = []
+    for a, b, knob in ctx.identity_pairs:
+        if knob != "serve" or ctx.texts[a] == ctx.texts[b]:
+            continue
+        out.append(Finding(
+            rule="hlo-serve-off-identity", location=f"{a} vs {b}",
+            message="serve/serve_max_batch/serve_max_wait_ms/serve_queue/"
+                    "serve_shed_ms changed the compiled step program — the "
+                    "serving plane must be invisible to the step lowering",
+        ))
+    return out
+
+
 def _check_no_s8(ctx: StepContext) -> list[Finding]:
     out = []
     for label, text in ctx.texts.items():
@@ -420,7 +458,7 @@ def _check_fused_no_dense(ctx: StepContext) -> list[Finding]:
     out = []
     for label, text in ctx.texts.items():
         shape = ctx.meta[label].forbid_dense_shape
-        if shape is None:
+        if shape is None or ctx.meta[label].serve_step:
             continue
         b, h = shape
         pat = re.compile(rf"tensor<(?:\d+x)*{b}x{h}x(?:f32|bf16|f16)>")
@@ -431,6 +469,30 @@ def _check_fused_no_dense(ctx: StepContext) -> list[Finding]:
                 message=f"{len(hits)} [B={b}, dict={h}] tensors in a "
                         f"fused-encoder-live step — the pre-act matrix "
                         f"the fusion exists to never materialize",
+            ))
+    return out
+
+
+def _check_serve_no_dense(ctx: StepContext) -> list[Finding]:
+    """The serve encode step inherits the fused tier's memory contract:
+    with the kernel live, the lowered serve program must carry no
+    ``[B, dict]`` float tensor — the whole point of serving through the
+    fusion is that per-request cost scales with ``[B, k]``, not the
+    dictionary width (docs/SERVING.md)."""
+    out = []
+    for label, text in ctx.texts.items():
+        shape = ctx.meta[label].forbid_dense_shape
+        if shape is None or not ctx.meta[label].serve_step:
+            continue
+        b, h = shape
+        pat = re.compile(rf"tensor<(?:\d+x)*{b}x{h}x(?:f32|bf16|f16)>")
+        hits = pat.findall(text)
+        if hits:
+            out.append(Finding(
+                rule="hlo-serve-no-dense-preacts", location=label,
+                message=f"{len(hits)} [B={b}, dict={h}] tensors in the "
+                        f"fused-live serve encode step — the dense pre-act "
+                        f"matrix must never materialize on the request path",
             ))
     return out
 
@@ -498,6 +560,12 @@ HLO_RULES: list[Rule] = [
     Rule("hlo-fleet-off-identity",
          "the multi-tenant fleet scheduler never changes the step lowering",
          _is_step_ctx, _check_fleet_off),
+    Rule("hlo-serve-off-identity",
+         "the serving plane never changes the train-step lowering",
+         _is_step_ctx, _check_serve_off),
+    Rule("hlo-serve-no-dense-preacts",
+         "the fused-live serve encode step carries no [B, dict] tensor",
+         _is_step_ctx, _check_serve_no_dense),
 ]
 
 

@@ -94,6 +94,23 @@ def _mut_fleet() -> StepContext:
     return ctx
 
 
+def _mut_serve() -> StepContext:
+    ctx = _step_ctx()
+    ctx.texts["off:serve"] = _CLEAN_HLO + "// an extra lowered op\n"
+    ctx.meta["off:serve"] = VariantMeta(n_donated_leaves=1)
+    ctx.jaxpr_consts["off:serve"] = []
+    ctx.identity_pairs = [("base", "off:serve", "serve")]
+    return ctx
+
+
+def _mut_serve_dense() -> StepContext:
+    ctx = _step_ctx()
+    ctx.meta["base"] = VariantMeta(n_donated_leaves=1, serve_step=True,
+                                   forbid_dense_shape=(192, 1024))
+    ctx.texts["base"] += "  %p = stablehlo.dot : tensor<192x1024xf32>\n"
+    return ctx
+
+
 def _mut_s8() -> StepContext:
     ctx = _step_ctx()
     ctx.texts["base"] += "  %q = stablehlo.convert : tensor<32x8xi8>\n"
@@ -234,6 +251,8 @@ MUTATIONS: dict[str, Callable[[], Any]] = {
     "hlo-elastic-off-identity": _mut_elastic,
     "hlo-elastic-grow-off-identity": _mut_elastic_grow,
     "hlo-fleet-off-identity": _mut_fleet,
+    "hlo-serve-off-identity": _mut_serve,
+    "hlo-serve-no-dense-preacts": _mut_serve_dense,
     "hlo-no-s8-when-quant-off": _mut_s8,
     "hlo-no-f64": _mut_f64,
     "hlo-donation-honored": _mut_donation,

@@ -439,6 +439,35 @@ class CrossCoderConfig:
                                     # tenants (stacked cohorts count one) —
                                     # admission beyond the cap is refused
                                     # rather than compiling unboundedly
+    # --- online serving (crosscoder_tpu/serve; docs/SERVING.md). Off by
+    # default and ZERO-COST off: none of these knobs is read inside the
+    # compiled train step, so the step lowering is byte-identical to a
+    # build without them (contracts rule hlo-serve-off-identity).
+    serve: str = "off"              # off | on: the online model-diffing
+                                    # request path (serve/engine.py): token
+                                    # streams admitted via ContinuousBatcher
+                                    # into paged LM harvest slots, fused
+                                    # encoder→TopK on the captured hooks,
+                                    # per-request top-k latents + decoder-
+                                    # norm diff scores returned — only
+                                    # [B, k] ever leaves the device
+    serve_max_batch: int = 8        # serve="on": micro-batch cap — the
+                                    # largest AOT-prewarmed batch bucket;
+                                    # power of two <= 128 so the bucket
+                                    # ladder stays <= 8 compiled shapes
+    serve_max_wait_ms: float = 5.0  # serve="on": deadline of the oldest
+                                    # admitted request before a partial
+                                    # plane flushes (flush on batch-full OR
+                                    # this timer — deadline-aware
+                                    # micro-batching)
+    serve_queue: int = 64           # serve="on": bounded admission queue;
+                                    # submits beyond it shed (429-style,
+                                    # serve/shed_total) instead of growing
+                                    # the queue unboundedly
+    serve_shed_ms: float = 0.0      # serve="on", > 0: max queue wait —
+                                    # queued requests older than this are
+                                    # evicted (counted in serve/shed_total)
+                                    # before a full queue sheds new arrivals
     # --- block-scaled int8 data plane (ops/quant.py; docs/SCALING.md
     # "Quantized data plane"). Both off by default and ZERO-COST off: the
     # compiled train step and the serve/refill paths are byte-identical to
@@ -840,6 +869,32 @@ class CrossCoderConfig:
                 "fleet_tenants is set but fleet='off'; pass --fleet on "
                 "(the spec would otherwise be silently ignored)"
             )
+        _check_choice("serve", self.serve, ("off", "on"))
+        if self.serve == "on":
+            b = self.serve_max_batch
+            if not 1 <= b <= 128 or b & (b - 1):
+                raise ValueError(
+                    f"serve_max_batch must be a power of two in [1, 128], "
+                    f"got {b} (each bucket in the 1..serve_max_batch "
+                    f"ladder is one AOT-prewarmed compiled shape; the "
+                    f"ladder must stay <= 8 buckets)"
+                )
+            if self.serve_max_wait_ms < 0:
+                raise ValueError(
+                    f"serve_max_wait_ms must be >= 0, got "
+                    f"{self.serve_max_wait_ms}"
+                )
+            if self.serve_queue < self.serve_max_batch:
+                raise ValueError(
+                    f"serve_queue ({self.serve_queue}) must be >= "
+                    f"serve_max_batch ({self.serve_max_batch}): the queue "
+                    f"must be able to hold at least one full micro-batch"
+                )
+            if self.serve_shed_ms < 0:
+                raise ValueError(
+                    f"serve_shed_ms must be >= 0 (0 disables queue-age "
+                    f"eviction), got {self.serve_shed_ms}"
+                )
         if self.quant_block < 1:
             raise ValueError(
                 f"quant_block must be >= 1, got {self.quant_block}; 256 is "

@@ -320,15 +320,19 @@ class ContinuousBatcher:
 
     def __init__(
         self, seq_len: int, n_rows: int, page_table: PageTable | None = None,
-        pad_id: int = 0,
+        pad_id: int = 0, max_wait_s: float | None = None,
     ) -> None:
         if n_rows < 1:
             raise ValueError(f"n_rows must be >= 1, got {n_rows}")
+        if max_wait_s is not None and max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
         self.seq_len = seq_len
         self.n_rows = n_rows
         self.page_table = page_table
         self.pad_id = pad_id
+        self.max_wait_s = max_wait_s
         self._docs: list[np.ndarray] = []
+        self._admitted_at: list[float] = []
         self._cursors = [0] * n_rows
         self._next_seq = 0
 
@@ -336,9 +340,11 @@ class ContinuousBatcher:
     def n_admitted(self) -> int:
         return len(self._docs)
 
-    def admit(self, doc: np.ndarray) -> bool:
+    def admit(self, doc: np.ndarray, now: float | None = None) -> bool:
         """Place one document (1-D token array); False = no slot has room
-        (or the page pool is exhausted) — flush first."""
+        (or the page pool is exhausted) — flush first. ``now`` stamps the
+        admission for the slot deadline (:meth:`due`); defaults to 0.0 so
+        callers without a deadline pay nothing."""
         doc = np.asarray(doc)
         ln = doc.shape[0]
         if not 1 <= ln <= self.seq_len:
@@ -352,13 +358,32 @@ class ContinuousBatcher:
                         return False
                 self._cursors[r] += ln
                 self._docs.append(doc)
+                self._admitted_at.append(0.0 if now is None else now)
                 self._next_seq += 1
                 return True
         return False
 
-    def flush(self) -> PackedChunk | None:
+    def oldest_wait(self, now: float) -> float:
+        """Seconds the OLDEST admitted document has been waiting (0.0 when
+        the plane is empty) — the deadline-aware micro-batching signal."""
+        if not self._admitted_at:
+            return 0.0
+        return now - self._admitted_at[0]
+
+    def due(self, now: float) -> bool:
+        """True when the oldest admitted document has waited past
+        ``max_wait_s``: the plane must flush even though it is not full —
+        the slot-deadline half of continuous batching (a partial plane is
+        latency bounded; an unbounded wait for batch-full is not)."""
+        if self.max_wait_s is None or not self._docs:
+            return False
+        return self.oldest_wait(now) >= self.max_wait_s
+
+    def flush(self, n_rows: int | None = None) -> PackedChunk | None:
         """Close the plane: retire every sequence and return the packed
-        chunk (None when nothing was admitted)."""
+        chunk (None when nothing was admitted). ``n_rows`` overrides the
+        plane height for this flush (compile-shape control for bucketed
+        serving; must cover the admitted placement)."""
         if not self._docs:
             return None
         D = len(self._docs)
@@ -370,8 +395,10 @@ class ContinuousBatcher:
         if self.page_table is not None:
             for s in range(self._next_seq - D, self._next_seq):
                 self.page_table.free(s)
-        chunk = pack_chunk(tokens, lengths, n_rows=self.n_rows,
+        chunk = pack_chunk(tokens, lengths,
+                           n_rows=self.n_rows if n_rows is None else n_rows,
                            pad_id=self.pad_id)
         self._docs = []
+        self._admitted_at = []
         self._cursors = [0] * self.n_rows
         return chunk

@@ -1073,6 +1073,61 @@ def run_with_cache_multi_paged(
     )
 
 
+def paged_capture_aot(
+    params_seq: Sequence[LMParams],
+    chunk,
+    cfg: LMConfig,
+    hook_points: Sequence[str],
+    *,
+    page_size: int,
+    pad_mode: str = "zero",
+    out_dtype=None,
+    on_build=None,
+) -> jax.Array:
+    """:func:`run_with_cache_multi_paged` for a PRE-PACKED fixed-shape
+    chunk, dispatched through an AOT-compiled executable.
+
+    ``chunk`` is a :class:`crosscoder_tpu.data.paging.PackedChunk` whose
+    plane height the caller pinned (the serve engine's bucket ladder pins
+    both the document count and the plane height per bucket, so every
+    steady-state request hits a memoized executable). Numerics are the
+    implicit-jit path's exactly — :func:`compile_cache.aot_get` compiles
+    the same program ``jax.jit`` would have — the AOT hop only removes
+    the per-call tracing/cache machinery from the latency path and makes
+    compiles COUNTABLE (``on_build`` fires once per executable actually
+    built; docs/SERVING.md "Zero compiles after warmup").
+    """
+    from crosscoder_tpu.ops import paged_attention as pa
+    from crosscoder_tpu.utils import compile_cache
+
+    cap_pairs = _hook_layers(cfg, tuple(hook_points))
+    n_scan = min(cfg.n_layers, _scan_stop(cap_pairs))
+    use_kernel = pa.kernel_enabled() and pa.supported(
+        chunk.n_docs, chunk.seq_len, cfg.n_heads, cfg.n_kv_heads,
+        cfg.head_dim, page_size,
+    )
+    if pad_mode not in ("zero", "wrap"):
+        raise ValueError(f"pad_mode must be zero|wrap, got {pad_mode!r}")
+    args = (
+        tuple(params_seq), jnp.asarray(chunk.tokens),
+        jnp.asarray(chunk.pos), jnp.asarray(chunk.doc_idx),
+        jnp.asarray(chunk.plane_idx), jnp.asarray(chunk.lengths),
+    )
+    key = ("paged_capture", cfg, cap_pairs, n_scan, page_size, use_kernel,
+           pad_mode, str(out_dtype), chunk.tokens.shape, chunk.doc_idx.shape,
+           str(chunk.tokens.dtype))
+    compiled = compile_cache.aot_get(
+        key,
+        lambda: _paged_multi_impl.lower(
+            *args, cfg=cfg, capture=cap_pairs, n_scan=n_scan,
+            page_size=page_size, use_kernel=use_kernel, pad_mode=pad_mode,
+            out_dtype=out_dtype,
+        ).compile(),
+        on_build=on_build,
+    )
+    return compiled(*args)
+
+
 # ---------------------------------------------------------------------------
 # tensor-parallel harvest (models too big for one chip's HBM)
 

@@ -1,0 +1,28 @@
+"""Low-latency model-diffing serving path (``cfg.serve``; docs/SERVING.md).
+
+Turns a trained crosscoder + its base LMs into an online request loop:
+token streams in, per-request top-k latent activations and decoder-norm
+model-diff scores out, with continuous batching over the paged harvest
+runtime and a zero-compiles-after-warmup AOT bucket ladder.
+
+Off by default and zero-cost off: with ``cfg.serve="off"`` nothing here
+imports and the train step's HLO is byte-identical to the serve-capable
+build (contracts rule ``hlo-serve-off-identity``).
+"""
+
+from crosscoder_tpu.serve.engine import (InferenceEngine, ServeResult, Shed,
+                                         batch_buckets, bucket_of)
+from crosscoder_tpu.serve.replica import ReplicaBoard, ServeReplica
+from crosscoder_tpu.serve.step import diff_pair, encode_topk_diff
+
+__all__ = [
+    "InferenceEngine",
+    "ServeResult",
+    "Shed",
+    "batch_buckets",
+    "bucket_of",
+    "ReplicaBoard",
+    "ServeReplica",
+    "diff_pair",
+    "encode_topk_diff",
+]

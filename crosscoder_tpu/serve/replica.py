@@ -1,0 +1,156 @@
+"""Serve-replica membership: drain the queue to peers on preemption.
+
+The serving plane inherits the training fleet's failure model: replicas
+run on preemptible capacity, so "a replica died" must cost the requests
+it had ADMITTED nothing more than one re-queue on a peer. The mechanism
+mirrors the elastic membership layer's filesystem rendezvous
+(:class:`crosscoder_tpu.resilience.elastic.RendezvousBoard` — shared
+storage, atomic tmp+rename writes, sequence-based freshness instead of
+synchronized clocks):
+
+- every replica ``announce``s itself with a monotonically increasing
+  heartbeat ``seq`` (a crashed replica goes stale within one poll);
+- a preempted replica's last act is ``post_drain``: it spools its
+  still-queued requests (:meth:`InferenceEngine.drain_queue`) to a drain
+  record on the board;
+- surviving peers ``claim`` drain records — the claim is an atomic
+  ``os.replace`` rename, so exactly one peer wins a record even when
+  several poll concurrently — and re-submit the spooled requests into
+  their own engines (``serve/adopted_total``).
+
+In-flight micro-batches (already dispatched to the device) are NOT
+drained: they complete or die with the host, exactly like a training
+step at preemption — the checkpoint analog here is that an unserved
+request is pure host state and therefore cheap to move.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+from crosscoder_tpu.obs import trace
+
+__all__ = ["ReplicaBoard", "ServeReplica"]
+
+
+class ReplicaBoard:
+    """Filesystem membership board for serve replicas (shared storage on
+    a real fleet; any directory in tests)."""
+
+    def __init__(self, root: str | os.PathLike) -> None:
+        self.root = Path(root)
+
+    def _write_json(self, path: Path, payload: dict) -> None:
+        self.root.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(f".tmp-{os.getpid()}")
+        tmp.write_text(json.dumps(payload))
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _read_json(path: Path) -> dict | None:
+        try:
+            return json.loads(path.read_text())
+        except (OSError, ValueError):
+            return None     # mid-replace or gone: treat as absent
+
+    # -- membership ------------------------------------------------------
+
+    def announce(self, replica_id: str, seq: int, *,
+                 queued: int = 0) -> None:
+        self._write_json(self.root / f"replica_{replica_id}.json", {
+            "id": replica_id, "seq": int(seq), "queued": int(queued),
+        })
+
+    def retract(self, replica_id: str) -> None:
+        with contextlib.suppress(OSError):
+            (self.root / f"replica_{replica_id}.json").unlink()
+
+    def peers(self, exclude: str | None = None) -> list[dict]:
+        return [rec for p in sorted(self.root.glob("replica_*.json"))
+                if (rec := self._read_json(p)) is not None
+                and rec.get("id") != exclude]
+
+    # -- drain hand-off --------------------------------------------------
+
+    def post_drain(self, replica_id: str,
+                   requests: list[tuple[int, np.ndarray]]) -> int:
+        """Spool a dying replica's queued requests to the board; returns
+        the count spooled. Token arrays serialize as plain lists — drain
+        records are tiny (queued requests only, never activations)."""
+        self._write_json(self.root / f"drain_{replica_id}.json", {
+            "id": replica_id,
+            "requests": [[int(rid), np.asarray(t).tolist()]
+                         for rid, t in requests],
+        })
+        return len(requests)
+
+    def claim_drains(self, claimant_id: str) -> list[dict]:
+        """Atomically claim every unclaimed drain record: the rename is
+        the lock — when two survivors race, ``os.replace`` succeeds for
+        exactly one (the loser's source path is already gone)."""
+        claimed = []
+        for p in sorted(self.root.glob("drain_*.json")):
+            if p.name.startswith(f"drain_{claimant_id}"):
+                continue    # never adopt your own spool
+            dst = p.with_name(f"claimed_{claimant_id}_{p.name}")
+            try:
+                os.replace(p, dst)
+            except OSError:
+                continue    # a peer won the race
+            rec = self._read_json(dst)
+            if rec is not None:
+                claimed.append(rec)
+        return claimed
+
+
+class ServeReplica:
+    """One engine registered on a :class:`ReplicaBoard`.
+
+    ``heartbeat()`` at the replica's poll cadence keeps the announce
+    fresh AND adopts any peer's drain spool it finds (re-submitting
+    through the engine's normal admission path — adopted requests face
+    the same backpressure as new ones; an overloaded survivor sheds them
+    rather than buckling). ``preempt()`` is the SIGTERM handler's body:
+    drain, spool, retract — after it returns the process can die without
+    losing a queued request.
+    """
+
+    def __init__(self, replica_id: str, engine, board: ReplicaBoard) -> None:
+        self.replica_id = replica_id
+        self.engine = engine
+        self.board = board
+        self._seq = 0
+
+    def heartbeat(self) -> int:
+        """One membership beat; returns the number of adopted requests."""
+        self._seq += 1
+        self.board.announce(self.replica_id, self._seq,
+                            queued=self.engine.n_queued)
+        adopted = 0
+        for rec in self.board.claim_drains(self.replica_id):
+            for _rid, tokens in rec.get("requests", []):
+                try:
+                    self.engine.submit(np.asarray(tokens, np.int32))
+                except Exception:   # noqa: BLE001 — Shed/backpressure:
+                    continue        # the request is lost here but was
+                                    # never acknowledged as adopted
+                adopted += 1
+                self.engine.registry.count("serve/adopted_total")
+        if adopted:
+            trace.instant("drain_adopt", replica=self.replica_id,
+                          requests=adopted)
+        return adopted
+
+    def preempt(self) -> int:
+        """Preemption hand-off: spool the queue, leave the board.
+        Returns the number of requests spooled for peers."""
+        drained = self.engine.drain_queue()
+        n = self.board.post_drain(self.replica_id, drained) if drained else 0
+        self.board.retract(self.replica_id)
+        trace.instant("drain_post", replica=self.replica_id, requests=n)
+        return n

@@ -74,7 +74,7 @@ def enable(cache_dir: str | None = None) -> str | None:
 _AOT_CACHE: dict[tuple, Any] = {}
 
 
-def aot_get(key: tuple, build: Any) -> Any:
+def aot_get(key: tuple, build: Any, on_build: Any | None = None) -> Any:
     """Process-wide memo of AOT-compiled executables.
 
     ``build()`` must return ``jit_fn.lower(*args).compile()`` for the
@@ -85,10 +85,18 @@ def aot_get(key: tuple, build: Any) -> Any:
     (docs/SCALING.md "Zero-bubble refill") — and keeps the donation and
     shardings of the jit it was lowered from: the compiled program is
     byte-identical to what the implicit jit call would have run.
+
+    ``on_build(key)`` fires only when ``build()`` actually ran — a cache
+    MISS. The serve engine counts misses through it to assert its
+    zero-compiles-after-warmup SLO (docs/SERVING.md): a steady-state
+    request that eats a compile is a bucket-ladder bug, not a latency
+    outlier.
     """
     got = _AOT_CACHE.get(key)
     if got is None:
         got = _AOT_CACHE[key] = build()
+        if on_build is not None:
+            on_build(key)
     return got
 
 

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# One-command serving-path smoke (docs/RUNBOOK.md "Serve smoke"): parity
+# vs the offline padded oracle, extend-path parity, the p99<=3*p50 SLO
+# gate at batch 8, and zero-compiles-after-warmup — on CPU tiny shapes.
+# Exit nonzero on any failure; one JSON line on stdout.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m crosscoder_tpu.serve.smoke "$@"

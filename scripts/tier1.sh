@@ -37,4 +37,10 @@ env JAX_PLATFORMS=cpu python -m crosscoder_tpu.resilience.elastic_drill \
 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_fleet.py::test_fleet_parity_stacked_and_bucketed \
     -q -p no:cacheprovider || exit 1
+# serve parity smoke: the online request path must hand back bitwise the
+# offline padded oracle's (vals, idx, diff) at mixed lengths
+# (docs/SERVING.md; the full serve surface runs in the suite below)
+env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_serve.py::test_served_bitwise_parity_mixed_lengths \
+    -q -p no:cacheprovider || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
