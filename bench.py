@@ -1,6 +1,7 @@
 """Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Twelve sections (env ``BENCH_SECTIONS``, default all; progress on stderr).
+Thirteen sections (env ``BENCH_SECTIONS``, default all; progress on
+stderr).
 Output contract: stdout carries exactly ONE machine-parseable JSON line,
 guaranteed last and guaranteed **compact** (≤2 KB: headline, per-section
 key numbers, gate booleans) — the driver truncates the line at 2000
@@ -70,6 +71,7 @@ per-chip parity — BASELINE.json.)
 Env knobs (debug/CI only): BENCH_SECTIONS, BENCH_DICT, BENCH_BATCH,
 BENCH_STEPS, BENCH_CPU=1, BENCH_MASTER_DTYPE, BENCH_QUANT=1 (e2e with
 the int8 replay store), QUANT_RELMSE_BOUND, BENCH_SERVE_REPS,
+BENCH_TUNE_STEPS (calibration window for the tune leg),
 BENCH_ARTIFACT (detail file path).
 """
 
@@ -351,11 +353,10 @@ def _encoder_hbm_bytes(cfg) -> dict:
         def loss(p, xb):
             return cc.training_loss(p, xb, 0.0, c, with_metrics=False)[0]
 
+        from crosscoder_tpu.utils import compile_cache
+
         compiled = jax.jit(jax.grad(loss)).lower(params, x).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):        # older jax returns [dict]
-            cost = cost[0] if cost else {}
-        return float(cost.get("bytes accessed", 0.0))
+        return compile_cache.extract_cost(compiled)["bytes_accessed"]
 
     fused_b = bytes_of(cfg)
     dense_b = bytes_of(cfg.replace(fused_encoder="off",
@@ -1359,6 +1360,91 @@ def section_serve() -> dict:
     return out
 
 
+def section_tune() -> dict:
+    """The autotuner end to end (docs/TUNING.md): the full two-stage
+    search over the train data-plane lattice at the bench shape, the
+    tuned-vs-default measured comparison, and the stage-1 serve-p99
+    prediction for the serve knob ladder. Gates: the pinned winner's
+    measured acts/s/chip ≥ the default knobs' (holds by construction —
+    the default candidate is always calibrated and the winner is chosen
+    on measured score) and stage-1 pricing added exactly ONE step
+    compile for the whole data-plane lattice (the ``aot_get`` reuse the
+    zero-cost-off contract promises)."""
+    import tempfile
+
+    from crosscoder_tpu.obs.registry import MetricsRegistry
+    from crosscoder_tpu.tune import tune
+    from crosscoder_tpu.tune.lattice import (default_axes, enumerate_lattice,
+                                             rank_candidates)
+    from crosscoder_tpu.utils import compile_cache
+
+    tiny = os.environ.get("BENCH_TINY") == "1"    # CI/debug only
+    shape = dict(d_in=32, dict_size=256, batch_size=64) if tiny else {}
+    cfg = _make_cfg(**shape, num_tokens=10**12, save_every=10**9,
+                    prefetch=False, checkpoint_dir=tempfile.mkdtemp())
+    axes = {
+        "prefetch": (False, True),
+        "refill_frac": (0.25, 0.5),
+        "refill_dispatch_batch": (4, 8),
+    }
+    steps = int(os.environ.get("BENCH_TUNE_STEPS", 3 if tiny else 8))
+    reg = MetricsRegistry()
+
+    def tune_step_compiles() -> int:
+        return sum(1 for k in compile_cache._AOT_CACHE
+                   if isinstance(k, tuple) and k and k[0] == "tune_step")
+
+    before = tune_step_compiles()
+    out_path = os.path.join(tempfile.mkdtemp(), "TUNED.json")
+    art = tune(cfg, "train", axes=axes, top_k=2, out_path=out_path,
+               steps=steps, warmup=1, seed=0, registry=reg)
+    pricing_compiles = tune_step_compiles() - before
+
+    default_knobs = {k: getattr(cfg, k) for k in axes}
+    rows = art.search.get("candidates", [])
+    default_row = next((r for r in rows if r.get("knobs") == default_knobs),
+                       None)
+    tuned_score = float(art.measured.get("score", 0.0))
+    default_score = (float(default_row["measured_score"])
+                     if default_row and default_row.get("measured_score")
+                     is not None else None)
+
+    # serve objective: stage-1 ranking over the bucket/wait/page ladder
+    # (prediction only — the measured serve p99 is section ``serve``'s
+    # job; here we report what the tuner would pin and why)
+    scfg = cfg.replace(serve="on")
+    serve_cands, _ = enumerate_lattice(scfg, default_axes(scfg, "serve"))
+    serve_ranked = rank_candidates(serve_cands, "serve", 1, seed=0)
+    serve_default = {k: getattr(scfg, k)
+                     for k in ("serve_max_batch", "serve_max_wait_ms",
+                               "page_size")}
+    sdef = next((c for c in serve_ranked if c.knobs == serve_default), None)
+    out = {
+        "tuned_knobs": art.knobs,
+        "tuned_acts_per_sec_chip": round(tuned_score, 2),
+        "default_acts_per_sec_chip": (round(default_score, 2)
+                                      if default_score is not None else None),
+        "tuned_vs_default": (round(tuned_score / default_score, 4)
+                             if default_score else None),
+        "tune_gate_ok": bool(default_score is None
+                             or tuned_score >= default_score),
+        "pricing_step_compiles": pricing_compiles,
+        "aot_reuse_ok": pricing_compiles <= 1,
+        "rejected_contract": reg.get_count("tune/rejected_contract"),
+        "n_candidates": art.search["n_candidates"],
+        "serve_p99_tuned_ms": (round(-serve_ranked[0].score, 3)
+                               if serve_ranked else None),
+        "serve_p99_default_ms": (round(-sdef.score, 3)
+                                 if sdef is not None else None),
+        "serve_knobs_tuned": serve_ranked[0].knobs if serve_ranked else None,
+        "artifact": out_path,
+        "workload": (f"{'tiny' if tiny else 'reference'} shape, "
+                     f"{len(axes)}-knob lattice, {steps}-step windows"),
+    }
+    log(f"[tune] {out}")
+    return out
+
+
 # stdout-summary projection: per section, the fields worth the 2 KB line
 _SUMMARY_KEYS = {
     "step": ("acts_per_sec_chip", "vs_a100_step"),
@@ -1375,13 +1461,17 @@ _SUMMARY_KEYS = {
               "harvest_amortization", "fleet_gate_ok"),
     "serve": ("p50_ms_b8", "p99_ms_b8", "req_s_saturated",
               "serve_gate_ok", "zero_compiles_ok"),
+    "tune": ("tuned_acts_per_sec_chip", "default_acts_per_sec_chip",
+             "tuned_vs_default", "serve_p99_tuned_ms",
+             "serve_p99_default_ms", "tune_gate_ok", "aot_reuse_ok"),
 }
 _GATES = (("refill_overlap", "gate_ok"), ("quant", "quality_gate_ok"),
           ("obs", "overhead_gate_ok"), ("e2e", "loss_finite"),
           ("elastic", "bitwise_equal"),
           ("elastic", "autoscale_bitwise_equal"),
           ("fleet", "fleet_gate_ok"),
-          ("serve", "serve_gate_ok"), ("serve", "zero_compiles_ok"))
+          ("serve", "serve_gate_ok"), ("serve", "zero_compiles_ok"),
+          ("tune", "tune_gate_ok"), ("tune", "aot_reuse_ok"))
 
 
 def _compact(headline: dict, results: dict) -> dict:
@@ -1477,7 +1567,7 @@ def _run_sections() -> dict:
     sections = os.environ.get(
         "BENCH_SECTIONS",
         "step,matrix,configs,e2e,refill_overlap,harvest,quant,obs,dash,"
-        "elastic,fleet,serve"
+        "elastic,fleet,serve,tune"
     ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
@@ -1489,7 +1579,8 @@ def _run_sections() -> dict:
                      ("dash", section_dash),
                      ("elastic", section_elastic),
                      ("fleet", section_fleet),
-                     ("serve", section_serve)):
+                     ("serve", section_serve),
+                     ("tune", section_tune)):
         if name not in sections:
             continue
         try:

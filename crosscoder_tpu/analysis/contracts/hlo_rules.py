@@ -255,6 +255,24 @@ def build_step_context(full: bool = True) -> StepContext:
         for label, overrides in KNOB_OFF_LATTICE:
             add(f"off:{label}", _cfg(**overrides))
             ctx.identity_pairs.append(("base", f"off:{label}", label))
+        # the tuned-artifact path (hlo-tuned-config-identity): loading a
+        # REAL TUNED.json whose knobs equal the defaults must lower the
+        # byte-identical step — the artifact machinery (apply_tuned +
+        # the cfg.tuned field itself) adds no hidden config drift
+        import tempfile
+
+        from crosscoder_tpu.tune.artifact import TunedArtifact, apply_tuned
+
+        with tempfile.TemporaryDirectory(prefix="contracts_tuned_") as td:
+            art = TunedArtifact(
+                objective="train",
+                knobs={"refill_frac": 0.5, "refill_dispatch_batch": 4,
+                       "prefetch": True, "quant_buffer": False},
+                mesh={"n_devices": 1, "n_model": 1},
+            )
+            path = art.save(f"{td}/TUNED.json")
+            add("off:tuned", apply_tuned(_cfg(), path))
+            ctx.identity_pairs.append(("base", "off:tuned", "tuned"))
         for act in ("topk", "batchtopk"):
             a = add(f"{act}:fused_off",
                     _cfg(activation=act, fused_encoder="off", **_SPARSE_SHAPE))
@@ -416,6 +434,27 @@ def _check_serve_off(ctx: StepContext) -> list[Finding]:
     return out
 
 
+def _check_tuned_identity(ctx: StepContext) -> list[Finding]:
+    """Loading a ``TUNED.json`` whose knobs equal the defaults must be a
+    no-op on the step lowering: the autotuner artifact path
+    (``apply_tuned`` through config resolution, plus the ``cfg.tuned``
+    field itself) may pin knob VALUES but must never introduce config
+    drift of its own (docs/TUNING.md "The artifact adds no hidden
+    drift"). Own rule, own mutation self-test, own name in the report."""
+    out = []
+    for a, b, knob in ctx.identity_pairs:
+        if knob != "tuned" or ctx.texts[a] == ctx.texts[b]:
+            continue
+        out.append(Finding(
+            rule="hlo-tuned-config-identity", location=f"{a} vs {b}",
+            message="a TUNED.json carrying the default knob values "
+                    "changed the compiled step program — the tuned-"
+                    "artifact path is drifting the config it claims to "
+                    "merely pin",
+        ))
+    return out
+
+
 def _check_no_s8(ctx: StepContext) -> list[Finding]:
     out = []
     for label, text in ctx.texts.items():
@@ -566,6 +605,9 @@ HLO_RULES: list[Rule] = [
     Rule("hlo-serve-no-dense-preacts",
          "the fused-live serve encode step carries no [B, dict] tensor",
          _is_step_ctx, _check_serve_no_dense),
+    Rule("hlo-tuned-config-identity",
+         "a default-knob TUNED.json never changes the step lowering",
+         _is_step_ctx, _check_tuned_identity),
 ]
 
 

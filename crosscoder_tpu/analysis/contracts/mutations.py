@@ -103,6 +103,15 @@ def _mut_serve() -> StepContext:
     return ctx
 
 
+def _mut_tuned() -> StepContext:
+    ctx = _step_ctx()
+    ctx.texts["off:tuned"] = _CLEAN_HLO + "// an extra lowered op\n"
+    ctx.meta["off:tuned"] = VariantMeta(n_donated_leaves=1)
+    ctx.jaxpr_consts["off:tuned"] = []
+    ctx.identity_pairs = [("base", "off:tuned", "tuned")]
+    return ctx
+
+
 def _mut_serve_dense() -> StepContext:
     ctx = _step_ctx()
     ctx.meta["base"] = VariantMeta(n_donated_leaves=1, serve_step=True,
@@ -252,6 +261,7 @@ MUTATIONS: dict[str, Callable[[], Any]] = {
     "hlo-elastic-grow-off-identity": _mut_elastic_grow,
     "hlo-fleet-off-identity": _mut_fleet,
     "hlo-serve-off-identity": _mut_serve,
+    "hlo-tuned-config-identity": _mut_tuned,
     "hlo-serve-no-dense-preacts": _mut_serve_dense,
     "hlo-no-s8-when-quant-off": _mut_s8,
     "hlo-no-f64": _mut_f64,

@@ -537,6 +537,13 @@ class CrossCoderConfig:
                                     # chaos.py grammar; tests/staging
                                     # only). Empty = no chaos objects
                                     # constructed anywhere.
+    tuned: str = ""                 # path to a pinned TUNED.json autotuner
+                                    # artifact (docs/TUNING.md). --tuned
+                                    # applies its knobs during from_cli
+                                    # resolution (after --config-json,
+                                    # before explicit flags); the elastic
+                                    # controller re-checks it on remesh.
+                                    # Empty = no tuner involvement.
 
     # master-weight/Adam-moment dtype. fp32 (default) is a quality upgrade
     # over the reference; "bf16" reproduces the reference exactly (its params
@@ -1044,6 +1051,18 @@ class CrossCoderConfig:
         ns = parser.parse_args(argv)
         if ns.config_json:
             base = cls.from_json(ns.config_json)
+        # tuned-artifact resolution order (docs/TUNING.md): defaults →
+        # --config-json → TUNED.json knobs → explicit flags. The artifact
+        # sits between the JSON and the flags so an operator can always
+        # override a pinned knob from the command line; --tuned "" clears
+        # an artifact a config JSON carried.
+        tuned_path = ns.tuned if ns.tuned is not None else base.tuned
+        if tuned_path:
+            from crosscoder_tpu.tune.artifact import apply_tuned
+
+            base = apply_tuned(base, tuned_path)
+        elif ns.tuned == "":
+            base = base.replace(tuned="")
         overrides: dict[str, Any] = {}
         for f in dataclasses.fields(cls):
             if f.name == "extras":

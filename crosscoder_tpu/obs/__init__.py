@@ -86,22 +86,17 @@ class Observability:
         """Report one compile event + the compiled program's collective
         accounting. Never raises: a cost-analysis/HLO-parsing failure
         degrades to the wall-time-only report."""
+        from crosscoder_tpu.utils import compile_cache
+
         r = self.registry
         r.count("perf/compiles")
         r.observe("perf/compile_s", wall_s)
-        flops = bytes_ = None
-        try:
-            cost = compiled.cost_analysis()
-            if isinstance(cost, (list, tuple)):    # older jax returns [dict]
-                cost = cost[0] if cost else {}
-            flops = cost.get("flops")
-            bytes_ = cost.get("bytes accessed")
-            if flops:
-                r.gauge("perf/compile_flops", float(flops))
-            if bytes_:
-                r.gauge("perf/compile_bytes_accessed", float(bytes_))
-        except Exception:
-            pass
+        cost = compile_cache.record_cost(key, compiled)
+        flops, bytes_ = cost["flops"], cost["bytes_accessed"]
+        if flops:
+            r.gauge("perf/compile_flops", flops)
+        if bytes_:
+            r.gauge("perf/compile_bytes_accessed", bytes_)
         try:
             self._account_comm(compiled)
         except Exception:

@@ -399,10 +399,30 @@ class ElasticController:
     def note_remesh(self, step: int) -> None:
         """Anchor the dwell clock: the trainer reports the step each
         shrink/grow resumed at, and ``grow_ready`` refuses another remesh
-        within ``cfg.elastic_dwell_steps`` of it (flap damping)."""
+        within ``cfg.elastic_dwell_steps`` of it (flap damping).
+
+        Also the autotuner's remesh hook (docs/TUNING.md "Re-tune on
+        remesh"): when the run carries a pinned ``TUNED.json``
+        (``cfg.tuned``), the new topology is checked against the
+        artifact — a per-topology cache hit swaps the tuned knobs in,
+        a miss flags the pinned knobs stale and counts it
+        (``resilience/retune_*``) so the operator re-tunes rather than
+        silently carrying knobs searched at another shape."""
         self._last_remesh_step = int(step)
         self._cand_freshness.clear()
         self._stable_candidates = []
+        if getattr(self.cfg, "tuned", ""):
+            from crosscoder_tpu.tune import artifact as tune_artifact
+
+            try:
+                self.cfg, status = tune_artifact.on_remesh(
+                    self.cfg, jax.device_count())
+            except Exception as e:  # noqa: BLE001 — remesh must survive
+                print(f"[crosscoder_tpu] elastic: tuned-artifact remesh "
+                      f"check failed ({type(e).__name__}: {e})"[:300],
+                      file=sys.stderr, flush=True)
+                status = "error"
+            self._bump(f"resilience/retune_{status}")
 
     def open_rejoin_window(self, serve: int) -> None:
         """The chaos ``return@S`` token lands here: model the fleet

@@ -87,7 +87,17 @@ class FleetPolicy:
         the per-round compute and DP-sync bytes uniformly across candidate
         splits — the RANKING is unchanged, but the modeled ``score_ms`` is
         the true per-round cost, which is what autoscale dwell/idle-cost
-        comparisons consume."""
+        comparisons consume.
+
+        A pinned tune artifact outranks both policies: when ``cfg.tuned``
+        is set and a ``TUNED.<topology>.json`` sibling exists for this
+        device count (docs/TUNING.md "Re-tune on remesh"), the searched
+        mesh shape is used verbatim — the autotuner already priced AND
+        measured the split, so re-deriving it from the analytic model
+        alone would discard information."""
+        tuned = self._tuned_choice(n_devices)
+        if tuned is not None:
+            return tuned
         if self.cfg.elastic_policy == "score":
             ranked = self.rank(n_devices, n_tenants)
             if ranked:
@@ -103,6 +113,49 @@ class FleetPolicy:
             )
         return MeshChoice(n_devices // m, m, None, {"policy": "fixed"})
 
+    def _tuned_choice(self, n_devices: int) -> MeshChoice | None:
+        """The mesh shape a per-topology tuned artifact pins for this
+        device count, or None when no artifact applies. Checks the pinned
+        artifact itself first, then its ``TUNED.<topology>.json`` cache
+        siblings over every valid TP width. Any artifact problem is a
+        miss, never an error — the remesh path must not die on a torn
+        file."""
+        if not getattr(self.cfg, "tuned", ""):
+            return None
+        from pathlib import Path
+
+        from crosscoder_tpu.tune import artifact as tune_artifact
+
+        def as_choice(art, src: str) -> MeshChoice | None:
+            if art is None:
+                return None
+            if int(art.mesh.get("n_devices", 0)) != n_devices:
+                return None
+            n_model = max(1, int(art.mesh.get("n_model", 1)))
+            if n_devices % n_model:
+                return None
+            return MeshChoice(
+                n_devices // n_model, n_model, None,
+                {"policy": "tuned", "artifact": src,
+                 "objective": art.objective},
+            )
+
+        try:
+            pinned = tune_artifact.load_tuned(self.cfg.tuned)
+        except ValueError:
+            pinned = None
+        got = as_choice(pinned, str(self.cfg.tuned))
+        if got is not None:
+            return got
+        root = Path(self.cfg.tuned).parent
+        for _, n_model in self.candidate_shapes(n_devices):
+            topo = tune_artifact.topology_key(n_devices, n_model)
+            got = as_choice(tune_artifact.cached_artifact(root, topo),
+                            str(tune_artifact.cache_path(root, topo)))
+            if got is not None:
+                return got
+        return None
+
     def rank(self, n_devices: int, n_tenants: int = 1) -> list[MeshChoice]:
         """Score every candidate split, cheapest modeled step first.
 
@@ -115,6 +168,7 @@ class FleetPolicy:
         """
         from crosscoder_tpu.parallel import comm_model
         from crosscoder_tpu.parallel import mesh as mesh_lib
+        from crosscoder_tpu.utils import compile_cache
 
         local = jax.device_count()
         choices: list[MeshChoice] = []
@@ -130,9 +184,9 @@ class FleetPolicy:
                     compiled = comm_model._compile_train_step(
                         self.cfg, ref_mesh
                     )
-                    cost = compiled.cost_analysis()
-                    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
-                    flops = float((cost or {}).get("flops", 0.0))
+                    flops = compile_cache.record_cost(
+                        ("fleet_rank", ref_data, n_model), compiled
+                    )["flops"]
                     profile = comm_model.CommProfile(
                         f"train_d{ref_data}_m{n_model}",
                         ref_data * n_model, n_model,

@@ -129,6 +129,13 @@ class InferenceEngine:
                 "is off by default and zero-cost off — "
                 "hlo-serve-off-identity)"
             )
+        if getattr(cfg, "tuned", ""):
+            # pin the serve-plane knobs from the tuned artifact
+            # (docs/TUNING.md): idempotent when from_cli already applied
+            # it; also covers engines constructed programmatically
+            from crosscoder_tpu.tune.artifact import apply_tuned
+
+            cfg = apply_tuned(cfg)
         self.cfg = cfg
         self.lm_cfg = lm_cfg
         self._lm_params = tuple(lm_params_seq)

@@ -232,6 +232,13 @@ class FleetScheduler:
     ) -> None:
         if cfg.fleet != "on":
             raise ValueError("FleetScheduler requires cfg.fleet='on'")
+        if getattr(cfg, "tuned", ""):
+            # pin the fleet/data-plane knobs from the tuned artifact
+            # (docs/TUNING.md): idempotent when from_cli already applied
+            # it; also covers schedulers constructed programmatically
+            from crosscoder_tpu.tune.artifact import apply_tuned
+
+            cfg = apply_tuned(cfg)
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else mesh_lib.mesh_from_cfg(cfg)
         if buffer is None:

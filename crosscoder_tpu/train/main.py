@@ -79,6 +79,12 @@ def main(argv: list[str] | None = None) -> Any:
 
     distributed = multihost.initialize()   # no-op single-process
     cfg = CrossCoderConfig.from_cli(argv)
+    if cfg.tuned:
+        # from_cli already applied the artifact's knobs (docs/TUNING.md);
+        # announce WHICH artifact pinned this run's knobs so logs are
+        # attributable to a search
+        print(f"[crosscoder_tpu] tuned: running with pinned artifact "
+              f"{cfg.tuned}", file=sys.stderr)
     mesh = mesh_lib.mesh_from_cfg(cfg)
     if distributed:
         print(f"[crosscoder_tpu] multihost: {multihost.process_info()}", file=sys.stderr)

@@ -1,0 +1,220 @@
+"""The pinned ``TUNED.json`` artifact: schema, load/apply, topology cache.
+
+A tune run ends in one small JSON document — the chosen knobs, the mesh
+shape they were searched at, the stage-1 cost-model predictions, the
+stage-2 measured scores, the contract-gate audit, and a hash of the
+fully-resolved config — so a deployment pins *exactly* what the search
+found, and ``--tuned <path>`` reproduces it through the normal config
+resolution path. The artifact adds no hidden drift: loading a
+``TUNED.json`` whose knobs equal the defaults lowers a byte-identical
+step program (contracts rule ``hlo-tuned-config-identity``).
+
+Artifacts are cached per topology (``TUNED.<topology>.json`` siblings of
+the loaded artifact), so an elastic remesh to a previously-tuned shape
+is a file read, not a re-search (:func:`on_remesh`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Any
+
+SCHEMA_VERSION = 1
+
+# every key a well-formed artifact must carry, with its required type —
+# scripts/tune_report.py and load_tuned() validate against this table
+_REQUIRED: tuple[tuple[str, type], ...] = (
+    ("version", int),
+    ("objective", str),
+    ("knobs", dict),
+    ("mesh", dict),
+    ("predicted", dict),
+    ("measured", dict),
+    ("gate", dict),
+    ("search", dict),
+    ("config_hash", str),
+)
+
+
+def topology_key(n_devices: int, n_model: int = 1) -> str:
+    """Canonical topology tag a tuned artifact is keyed by: total device
+    count plus the TP width (the two inputs that change the step program
+    and the DP ring width — EQuARX's point that quantized-plane knobs
+    interact with mesh shape and must be re-searched per topology)."""
+    return f"d{int(n_devices)}m{int(n_model)}"
+
+
+def config_hash(cfg: Any) -> str:
+    """SHA-256 of the fully-resolved config JSON (minus the artifact path
+    itself, which would make the hash self-referential)."""
+    d = cfg.to_dict()
+    d.pop("tuned", None)
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True, default=str).encode()
+    ).hexdigest()
+
+
+@dataclasses.dataclass
+class TunedArtifact:
+    """One pinned tune result (see module docstring for field meaning)."""
+
+    objective: str
+    knobs: dict[str, Any]
+    mesh: dict[str, int]
+    predicted: dict[str, Any] = dataclasses.field(default_factory=dict)
+    measured: dict[str, Any] = dataclasses.field(default_factory=dict)
+    gate: dict[str, Any] = dataclasses.field(default_factory=dict)
+    search: dict[str, Any] = dataclasses.field(default_factory=dict)
+    config_hash: str = ""
+    version: int = SCHEMA_VERSION
+
+    @property
+    def topology(self) -> str:
+        return topology_key(self.mesh.get("n_devices", 1),
+                            self.mesh.get("n_model", 1))
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["topology"] = self.topology
+        return d
+
+    def save(self, path: str | Path) -> Path:
+        """Atomic write (tmp + rename): a torn artifact must never load."""
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_text(json.dumps(self.to_dict(), indent=2, sort_keys=True,
+                                  default=str))
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TunedArtifact":
+        for key, typ in _REQUIRED:
+            if key not in d:
+                raise ValueError(f"TUNED artifact missing required key "
+                                 f"{key!r}")
+            if not isinstance(d[key], typ):
+                raise ValueError(
+                    f"TUNED artifact key {key!r} must be "
+                    f"{typ.__name__}, got {type(d[key]).__name__}")
+        if d["version"] != SCHEMA_VERSION:
+            raise ValueError(f"TUNED artifact schema version {d['version']} "
+                             f"!= supported {SCHEMA_VERSION}")
+        if not d["knobs"]:
+            raise ValueError("TUNED artifact has an empty knob set — "
+                             "nothing to apply")
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+def load_tuned(path: str | Path) -> TunedArtifact:
+    """Parse + validate one artifact; raises ``ValueError`` on anything
+    malformed (unreadable file, non-JSON, missing/ill-typed keys) so CLIs
+    and CI can gate on artifact validity."""
+    try:
+        data = json.loads(Path(path).read_text())
+    except OSError as e:
+        raise ValueError(f"cannot read {path}: {e}")
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{path} is not valid JSON: {e}")
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: top-level JSON must be an object")
+    return TunedArtifact.from_dict(data)
+
+
+def apply_tuned(cfg: Any, path: str | Path | None = None) -> Any:
+    """Resolve ``cfg`` through a tuned artifact: the artifact's knobs are
+    applied over ``cfg`` (re-validated by ``CrossCoderConfig.__post_init__``
+    — a stale artifact whose knobs no longer pass validation fails loudly
+    here, not three hours into a run). ``path`` defaults to ``cfg.tuned``;
+    with neither set this is the identity. Knob names must be real config
+    fields: an artifact knob that is not a field is a schema violation,
+    not an ``extras`` passenger."""
+    path = path if path is not None else getattr(cfg, "tuned", "")
+    if not path:
+        return cfg
+    art = load_tuned(path)
+    fields = {f.name for f in dataclasses.fields(type(cfg))}
+    unknown = sorted(set(art.knobs) - fields)
+    if unknown:
+        raise ValueError(
+            f"TUNED artifact {path} carries unknown knob(s) {unknown} — "
+            f"not CrossCoderConfig fields")
+    knobs = dict(art.knobs)
+    # JSON has no tuples: restore tuple-typed fields before replace()
+    for k, v in knobs.items():
+        if isinstance(getattr(cfg, k), tuple) and isinstance(v, list):
+            knobs[k] = tuple(v)
+    return cfg.replace(tuned=str(path), **knobs)
+
+
+# ---------------------------------------------------------------------------
+# per-topology artifact cache (the re-tune-on-remesh lifecycle)
+# ---------------------------------------------------------------------------
+
+
+def cache_path(root: str | Path, topology: str) -> Path:
+    return Path(root) / f"TUNED.{topology}.json"
+
+
+def cached_artifact(root: str | Path, topology: str) -> TunedArtifact | None:
+    """The pinned artifact for ``topology`` under ``root``, or None. A
+    malformed cache entry is treated as a miss (reported to stderr), never
+    an error — the remesh path must not die on a torn file."""
+    p = cache_path(root, topology)
+    if not p.exists():
+        return None
+    try:
+        return load_tuned(p)
+    except ValueError as e:
+        print(f"[crosscoder_tpu] tune: ignoring malformed cached artifact "
+              f"{p}: {e}", file=sys.stderr, flush=True)
+        return None
+
+
+def on_remesh(cfg: Any, n_devices: int) -> tuple[Any, str]:
+    """The remesh hook (docs/TUNING.md "Re-tune on remesh").
+
+    Called by the elastic controller when the world changes shape. With
+    no pinned artifact (``cfg.tuned`` empty) it is a no-op. Otherwise:
+
+    - if a cached ``TUNED.<topology>.json`` sibling exists for the NEW
+      topology, its knobs replace the pinned ones (``cache_hit``);
+    - if the pinned artifact was already searched at this topology, the
+      knobs stand (``current``);
+    - else the pinned knobs are STALE for this shape: the config is
+      returned unchanged but flagged, so the caller can count it and
+      schedule a re-tune (``stale``) — carrying stale hand-tuned knobs
+      silently across a shape change is the failure mode this hook
+      exists to prevent.
+
+    Returns ``(cfg, status)`` with status in
+    ``{"off", "current", "cache_hit", "stale"}``.
+    """
+    if not getattr(cfg, "tuned", ""):
+        return cfg, "off"
+    n_model = max(1, int(cfg.model_axis_size))
+    topo = topology_key(n_devices, n_model)
+    try:
+        pinned = load_tuned(cfg.tuned)
+    except ValueError:
+        pinned = None
+    if pinned is not None and pinned.topology == topo:
+        return cfg, "current"
+    cached = cached_artifact(Path(cfg.tuned).parent, topo)
+    if cached is not None:
+        path = cache_path(Path(cfg.tuned).parent, topo)
+        print(f"[crosscoder_tpu] tune: remesh to {topo} — applying cached "
+              f"artifact {path}", file=sys.stderr, flush=True)
+        return apply_tuned(cfg, path), "cache_hit"
+    print(f"[crosscoder_tpu] tune: remesh to {topo} — pinned artifact "
+          f"{cfg.tuned} was searched at "
+          f"{pinned.topology if pinned else 'unknown'}; knobs are STALE, "
+          f"re-tune recommended", file=sys.stderr, flush=True)
+    return cfg, "stale"

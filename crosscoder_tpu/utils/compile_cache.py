@@ -73,6 +73,59 @@ def enable(cache_dir: str | None = None) -> str | None:
 
 _AOT_CACHE: dict[tuple, Any] = {}
 
+# key → {"flops": float, "bytes_accessed": float} for every executable
+# that passed through here; the autotuner's stage-1 pricing and the
+# report tooling query it via cost_of() instead of re-pulling
+# cost_analysis() ad hoc
+_COST_CACHE: dict[Any, dict[str, float]] = {}
+
+# key → executable whose cost analysis has not been pulled yet: aot_get
+# stashes here instead of paying cost_analysis() on the hot compile path
+# (it is not free on large programs), and cost_of() settles on demand
+_COST_PENDING: dict[Any, Any] = {}
+
+
+def extract_cost(compiled: Any) -> dict[str, float]:
+    """FLOPs / bytes-accessed of a compiled executable, normalized.
+
+    The single place the repo reads ``compiled.cost_analysis()`` — older
+    jax returns a list-wrapped dict, newer a bare dict, and either may
+    omit keys; callers (obs compile events, the fleet policy's analytic
+    ranking, bench's HBM-traffic numbers, the tune lattice) get a plain
+    ``{"flops", "bytes_accessed"}`` dict with 0.0 for anything missing.
+    Never raises: an executable without cost analysis prices as zeros.
+    """
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):    # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        return {
+            "flops": float(cost.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0) or 0.0),
+        }
+    except Exception:
+        return {"flops": 0.0, "bytes_accessed": 0.0}
+
+
+def record_cost(key: Any, compiled: Any) -> dict[str, float]:
+    """Extract + memoize the cost analysis of ``compiled`` under ``key``
+    (tuple AOT keys and string variant keys share one table)."""
+    _COST_PENDING.pop(key, None)
+    cost = extract_cost(compiled)
+    _COST_CACHE[key] = cost
+    return cost
+
+
+def cost_of(key: Any) -> dict[str, float] | None:
+    """The memoized HLO cost analysis for a previously compiled variant,
+    or ``None`` if nothing under ``key`` has compiled in this process.
+    Executables stashed lazily by :func:`aot_get` settle here on first
+    query."""
+    got = _COST_CACHE.get(key)
+    if got is None and key in _COST_PENDING:
+        got = record_cost(key, _COST_PENDING.pop(key))
+    return got
+
 
 def aot_get(key: tuple, build: Any, on_build: Any | None = None) -> Any:
     """Process-wide memo of AOT-compiled executables.
@@ -95,6 +148,7 @@ def aot_get(key: tuple, build: Any, on_build: Any | None = None) -> Any:
     got = _AOT_CACHE.get(key)
     if got is None:
         got = _AOT_CACHE[key] = build()
+        _COST_PENDING[key] = got      # cost_of() settles this on demand
         if on_build is not None:
             on_build(key)
     return got

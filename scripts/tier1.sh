@@ -43,4 +43,8 @@ env JAX_PLATFORMS=cpu python -m pytest \
 env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_serve.py::test_served_bitwise_parity_mixed_lengths \
     -q -p no:cacheprovider || exit 1
+# autotuner smoke: enumerate the CPU knob lattice, price it, calibrate the
+# top-K through the real Trainer, gate every candidate on the contracts
+# engine, and round-trip the pinned TUNED.json (docs/TUNING.md)
+env JAX_PLATFORMS=cpu python -m crosscoder_tpu.tune.smoke || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Render a pinned ``TUNED.json`` autotuner artifact as a readable table.
+
+``python scripts/tune_report.py <TUNED.json>`` prints the chosen knob
+assignment, the stage-1 predicted vs stage-2 measured scores, the
+contract-gate audit (checked/rejected counts plus each calibrated
+candidate's gate status), and the search provenance (axes, lattice size,
+seed, topology, config hash) — so an artifact pulled off an air-gapped
+pod answers "what did the tuner pick, and why" from the terminal. Exits
+nonzero on malformed artifacts (unreadable file, non-JSON, missing or
+ill-typed schema keys), mirroring ``scripts/trace_report.py``, so CI and
+drivers can gate on artifact validity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render(art) -> str:
+    """The report text for one validated TunedArtifact."""
+    lines: list[str] = []
+    m = art.mesh
+    lines.append(f"objective: {art.objective}    topology: {art.topology} "
+                 f"(n_devices={m.get('n_devices')}, "
+                 f"n_model={m.get('n_model')})")
+    lines.append(f"config_hash: {art.config_hash or '(unset)'}")
+
+    hdr = f"{'knob':<24} {'chosen':>14}"
+    lines += ["", hdr, "-" * len(hdr)]
+    for k in sorted(art.knobs):
+        lines.append(f"{k:<24} {_fmt(art.knobs[k]):>14}")
+
+    hdr = f"{'metric':<24} {'predicted':>14} {'measured':>14}"
+    lines += ["", hdr, "-" * len(hdr)]
+    keys = sorted(set(art.predicted) | set(art.measured))
+    for k in keys:
+        p = art.predicted.get(k)
+        mv = art.measured.get(k)
+        lines.append(f"{k:<24} {_fmt(p) if p is not None else '-':>14} "
+                     f"{_fmt(mv) if mv is not None else '-':>14}")
+
+    g = art.gate
+    lines += ["", f"contracts gate: {g.get('checked', '?')} candidate(s) "
+                  f"checked, {g.get('rejected', '?')} rejected "
+                  f"({g.get('rule_set', 'unknown rule set')})"]
+    cands = art.search.get("candidates") or []
+    if cands:
+        hdr = (f"{'candidate knobs':<52} {'gate':>8} {'predicted':>12} "
+               f"{'measured':>12}")
+        lines += ["", hdr, "-" * len(hdr)]
+        for row in cands:
+            knobs = ",".join(f"{k}={v}"
+                             for k, v in sorted(row.get("knobs", {}).items()))
+            pred = row.get("predicted_score")
+            meas = row.get("measured_score")
+            lines.append(
+                f"{knobs[:52]:<52} {row.get('gate', '?'):>8} "
+                f"{_fmt(pred) if pred is not None else '-':>12} "
+                f"{_fmt(meas) if meas is not None else '-':>12}")
+
+    s = art.search
+    lines += ["", f"search: {s.get('n_candidates', '?')} candidates over "
+                  f"axes {sorted(s.get('axes', {}))} "
+                  f"({s.get('n_pruned_invalid', 0)} pruned invalid, "
+                  f"{s.get('n_priced', '?')} priced, top_k="
+                  f"{s.get('top_k', '?')}, seed={s.get('seed', '?')}, "
+                  f"{s.get('calibration_steps', '?')} calibration steps)"]
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact", help="path to TUNED.json")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit the validated artifact as JSON instead "
+                         "of the table (for piping)")
+    args = ap.parse_args(argv)
+    from crosscoder_tpu.tune.artifact import load_tuned
+
+    try:
+        art = load_tuned(args.artifact)
+    except ValueError as e:
+        print(f"tune_report: MALFORMED ARTIFACT: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(art.to_dict(), indent=2, sort_keys=True,
+                         default=str))
+        return 0
+    print(render(art))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,496 @@
+"""Autotuner tests: lattice validity, deterministic ranking, the rigged
+two-candidate race, the contracts gate's rejection accounting, artifact
+schema validation, the ``--tuned`` round-trip through train/main.py, the
+per-topology remesh lifecycle, and scripts/tune_report.py's exit codes.
+
+Stage-1 pricing normally compiles one step per distinct step signature;
+these tests monkeypatch :func:`crosscoder_tpu.tune.lattice._step_cost`
+with a constant so the search logic is exercised without a compiler in
+the loop (the real compile path is covered by the tier-1 tune smoke,
+``python -m crosscoder_tpu.tune.smoke``, and the bench ``tune`` leg).
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+from crosscoder_tpu.config import CrossCoderConfig
+from crosscoder_tpu.obs.registry import MetricsRegistry
+from crosscoder_tpu.tune import artifact as tune_artifact
+from crosscoder_tpu.tune import autotune, lattice
+from crosscoder_tpu.tune.artifact import (TunedArtifact, apply_tuned,
+                                          config_hash, load_tuned, on_remesh,
+                                          topology_key)
+from crosscoder_tpu.tune.lattice import (Candidate, default_axes,
+                                         enumerate_lattice, rank_candidates)
+
+_SCRIPTS = Path(__file__).parent.parent / "scripts"
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(name, _SCRIPTS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def tiny_cfg(**kw):
+    base = dict(d_in=8, dict_size=32, batch_size=32, enc_dtype="fp32",
+                log_backend="null")
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+_FLAT_COST = {"flops": 1e9, "bytes_accessed": 1e8, "wire_bytes": 0.0}
+
+
+@pytest.fixture
+def flat_step_cost(monkeypatch):
+    """Constant device terms: pricing differences come only from the
+    data-plane model, and no compiler runs."""
+    monkeypatch.setattr(lattice, "_step_cost",
+                        lambda cand, n_devices: dict(_FLAT_COST))
+
+
+# ---------------------------------------------------------------------------
+# lattice enumeration
+# ---------------------------------------------------------------------------
+
+
+def test_lattice_prunes_exactly_the_config_invalid_points():
+    """The lattice is filtered by config.py's OWN validation: refill_frac
+    above 0.5 and a zero dispatch batch both raise in __post_init__, so
+    those products are pruned; everything else survives as a validated
+    config whose attributes equal the knob assignment."""
+    cfg = tiny_cfg()
+    axes = {
+        "refill_frac": (0.25, 0.5, 0.75),       # 0.75 > serve trigger: invalid
+        "refill_dispatch_batch": (0, 4),        # 0 quanta/dispatch: invalid
+        "prefetch": (False, True),
+    }
+    cands, pruned = enumerate_lattice(cfg, axes)
+    assert len(cands) == 4                      # 2 valid fracs x 1 batch x 2
+    assert pruned == 8
+    for c in cands:
+        # the validated config really carries the knob assignment…
+        for k, v in c.knobs.items():
+            assert getattr(c.cfg, k) == v
+        # …and satisfies the constraints the pruned points violated
+        assert 0.0 < c.cfg.refill_frac <= 0.5
+        assert c.cfg.refill_dispatch_batch >= 1
+    # every surviving point is unique and carries the shared base signature
+    assert len({json.dumps(c.knobs, sort_keys=True) for c in cands}) == 4
+    assert len({c.base_sig for c in cands}) == 1
+
+
+def test_lattice_empty_when_everything_invalid():
+    cands, pruned = enumerate_lattice(tiny_cfg(), {"refill_frac": (0.9,)})
+    assert cands == [] and pruned == 1
+
+
+def test_default_axes_shapes():
+    cfg = tiny_cfg(seq_len=64)
+    for objective in lattice.OBJECTIVES:
+        axes = default_axes(cfg, objective)
+        assert len(axes) >= 3
+        assert all(len(v) >= 1 for v in axes.values())
+    # serve page_size axis only offers divisors of seq_len
+    for p in default_axes(cfg, "serve")["page_size"]:
+        assert cfg.seq_len % p == 0
+    with pytest.raises(ValueError):
+        default_axes(cfg, "nope")
+
+
+# ---------------------------------------------------------------------------
+# stage-1 ranking
+# ---------------------------------------------------------------------------
+
+
+def test_ranking_deterministic_under_fixed_seed(flat_step_cost):
+    """Same seed, same order — including across exact score ties (with
+    refill_overlap='off' the dispatch-batch knob cannot move the price,
+    so those candidates tie and the seeded hash must break them
+    identically every run)."""
+    cfg = tiny_cfg(refill_overlap="off")
+    axes = {"refill_dispatch_batch": (2, 4, 8, 16),
+            "prefetch": (False, True)}
+
+    def order(seed):
+        cands, _ = enumerate_lattice(cfg, axes)
+        ranked = rank_candidates(cands, "train", 1, seed)
+        return [json.dumps(c.knobs, sort_keys=True) for c in ranked]
+
+    assert order(seed=0) == order(seed=0)
+    assert order(seed=7) == order(seed=7)
+    # ranking is a permutation of the lattice, scores best-first
+    cands, _ = enumerate_lattice(cfg, axes)
+    ranked = rank_candidates(cands, "train", 1, 0)
+    assert len(ranked) == 8
+    scores = [c.score for c in ranked]
+    assert scores == sorted(scores, reverse=True)
+    # prefetch=True hides the gather, so it never ranks below its
+    # prefetch=False twin
+    best = ranked[0]
+    assert best.knobs["prefetch"] is True
+
+
+def test_pricing_fills_predictions(flat_step_cost):
+    cands, _ = enumerate_lattice(tiny_cfg(), {"prefetch": (False, True)})
+    ranked = rank_candidates(cands, "train", 1, 0)
+    for c in ranked:
+        assert c.predicted["score"] == c.score > 0
+        assert {"device_ms", "wire_ms", "step_total_ms",
+                "harvest_ms"} <= set(c.predicted)
+
+
+# ---------------------------------------------------------------------------
+# the tune driver (stage 2 rigged through the injectable seams)
+# ---------------------------------------------------------------------------
+
+
+def _pass_gate(cfg, knobs=None):
+    return True, []
+
+
+def test_rigged_race_picks_the_planted_winner(flat_step_cost, tmp_path):
+    """Stage 2 overrules stage 1: the measured window plants the win on a
+    knob assignment the cost model ranks LAST (prefetch=False scores
+    worse analytically), and tune() must pin exactly that assignment."""
+    cfg = tiny_cfg()
+    planted = {"prefetch": False, "refill_frac": 0.25}
+
+    def measure(mcfg, *, steps, warmup, n_devices):
+        won = (mcfg.prefetch, mcfg.refill_frac) == (False, 0.25)
+        s = 1e6 if won else 10.0
+        return {"score": s, "acts_per_sec_chip": s, "step_ms": 1.0,
+                "bubble_frac": 0.0}
+
+    out = tmp_path / "TUNED.json"
+    reg = MetricsRegistry()
+    art = autotune.tune(
+        cfg, "train",
+        axes={"prefetch": (False, True), "refill_frac": (0.25, 0.5)},
+        top_k=4, out_path=str(out), registry=reg,
+        measure=measure, gate=_pass_gate)
+    assert art.knobs == planted
+    assert art.measured["score"] == 1e6
+    assert reg.get_count("tune/candidates") == 4
+    assert reg.get_count("tune/calibrated") == 4
+    assert reg.get_count("tune/emitted") == 1
+    # the pinned file round-trips to the same knobs
+    assert load_tuned(out).knobs == planted
+    # the audit trail carries every calibrated candidate
+    assert len(art.search["candidates"]) == 4
+    assert all(r["gate"] == "pass" for r in art.search["candidates"])
+
+
+def test_contract_violator_is_discarded_and_counted(flat_step_cost):
+    """A candidate the contracts gate rejects never ships: it is dropped
+    from the race, counted under tune/rejected_contract, and recorded in
+    the artifact's audit trail with its findings."""
+    cfg = tiny_cfg()
+
+    def gate(gcfg, knobs=None):
+        if gcfg.prefetch:            # reject the analytically-better half
+            return False, ["hlo-knob-off-identity: seeded violation"]
+        return True, []
+
+    def measure(mcfg, *, steps, warmup, n_devices):
+        return {"score": 100.0}
+
+    reg = MetricsRegistry()
+    art = autotune.tune(cfg, "train", axes={"prefetch": (False, True)},
+                        top_k=2, registry=reg, measure=measure, gate=gate)
+    assert art.knobs == {"prefetch": False}
+    assert reg.get_count("tune/rejected_contract") == 1
+    assert art.gate["rejected"] == 1 and art.gate["checked"] == 2
+    rejected = [r for r in art.search["candidates"]
+                if r["gate"] == "rejected"]
+    assert len(rejected) == 1
+    assert rejected[0]["knobs"] == {"prefetch": True}
+    assert "seeded violation" in rejected[0]["findings"][0]
+
+
+def test_all_candidates_rejected_refuses_to_emit(flat_step_cost):
+    with pytest.raises(ValueError, match="rejected by the contracts gate"):
+        autotune.tune(
+            tiny_cfg(), "train", axes={"prefetch": (False, True)},
+            gate=lambda cfg, knobs=None: (False, ["no"]),
+            measure=lambda cfg, **kw: {"score": 1.0})
+
+
+def test_empty_lattice_refuses_to_emit(flat_step_cost):
+    with pytest.raises(ValueError, match="config validation"):
+        autotune.tune(tiny_cfg(), "train", axes={"refill_frac": (0.9,)})
+
+
+def test_default_knobs_always_calibrated(flat_step_cost):
+    """top_k=1 still measures the base config's own knob assignment, so
+    the winner can never measure worse than the user's defaults."""
+    cfg = tiny_cfg()            # defaults: prefetch=True, refill_frac=0.5
+    seen = []
+
+    def measure(mcfg, *, steps, warmup, n_devices):
+        seen.append((mcfg.prefetch, mcfg.refill_frac))
+        return {"score": 50.0}
+
+    autotune.tune(cfg, "train",
+                  axes={"prefetch": (False, True),
+                        "refill_frac": (0.25, 0.5)},
+                  top_k=1, measure=measure, gate=_pass_gate)
+    assert (True, 0.5) in seen          # the default-knob candidate
+
+
+# ---------------------------------------------------------------------------
+# artifact schema
+# ---------------------------------------------------------------------------
+
+
+def _valid_art(**kw):
+    base = dict(objective="train", knobs={"prefetch": False},
+                mesh={"n_devices": 1, "n_model": 1})
+    base.update(kw)
+    return TunedArtifact(**base)
+
+
+def test_artifact_round_trip_and_topology(tmp_path):
+    art = _valid_art(mesh={"n_devices": 8, "n_model": 2},
+                     measured={"score": 3.5})
+    assert art.topology == "d8m2" == topology_key(8, 2)
+    p = art.save(tmp_path / "TUNED.json")
+    got = load_tuned(p)
+    assert got.knobs == art.knobs
+    assert got.measured == art.measured
+    assert got.topology == "d8m2"
+
+
+@pytest.mark.parametrize("breakage", [
+    lambda d: d.pop("knobs"),                       # missing key
+    lambda d: d.update(knobs=[]),                   # ill-typed key
+    lambda d: d.update(knobs={}),                   # empty knob set
+    lambda d: d.update(version=99),                 # wrong schema version
+])
+def test_artifact_validation_rejects(tmp_path, breakage):
+    d = _valid_art().to_dict()
+    breakage(d)
+    p = tmp_path / "TUNED.json"
+    p.write_text(json.dumps(d, default=str))
+    with pytest.raises(ValueError):
+        load_tuned(p)
+
+
+@pytest.mark.parametrize("payload", ["", "not json {", "[1, 2]"])
+def test_load_tuned_rejects_non_artifacts(tmp_path, payload):
+    p = tmp_path / "TUNED.json"
+    p.write_text(payload)
+    with pytest.raises(ValueError):
+        load_tuned(p)
+    with pytest.raises(ValueError):
+        load_tuned(tmp_path / "no_such_file.json")
+
+
+def test_apply_tuned(tmp_path):
+    cfg = tiny_cfg()
+    p = _valid_art(knobs={"prefetch": False, "refill_frac": 0.25}).save(
+        tmp_path / "TUNED.json")
+    got = apply_tuned(cfg, p)
+    assert got.prefetch is False and got.refill_frac == 0.25
+    assert got.tuned == str(p)
+    # config_hash ignores the artifact path (no self-reference)
+    assert config_hash(got) == config_hash(
+        cfg.replace(prefetch=False, refill_frac=0.25))
+    # identity with nothing pinned
+    assert apply_tuned(cfg) is cfg
+    # unknown knob names are a schema violation, not an extras passenger
+    _valid_art(knobs={"no_such_knob": 1}).save(tmp_path / "BAD.json")
+    with pytest.raises(ValueError, match="unknown knob"):
+        apply_tuned(cfg, tmp_path / "BAD.json")
+    # a stale artifact whose knobs no longer validate fails loudly
+    _valid_art(knobs={"refill_frac": 0.9}).save(tmp_path / "STALE.json")
+    with pytest.raises(ValueError, match="refill_frac"):
+        apply_tuned(cfg, tmp_path / "STALE.json")
+
+
+# ---------------------------------------------------------------------------
+# re-tune on remesh
+# ---------------------------------------------------------------------------
+
+
+def test_on_remesh_lifecycle(tmp_path):
+    # off: nothing pinned
+    cfg = tiny_cfg()
+    assert on_remesh(cfg, 2) == (cfg, "off")
+
+    pinned = _valid_art(knobs={"refill_frac": 0.5},
+                        mesh={"n_devices": 1, "n_model": 1})
+    p = pinned.save(tmp_path / "TUNED.json")
+    cfg = apply_tuned(tiny_cfg(), p)
+
+    # current: the pinned artifact was searched at this very topology
+    got, status = on_remesh(cfg, 1)
+    assert status == "current" and got.refill_frac == 0.5
+
+    # stale: new shape, no cached sibling — knobs stand but are flagged
+    got, status = on_remesh(cfg, 4)
+    assert status == "stale" and got.refill_frac == 0.5
+
+    # cache_hit: a TUNED.d4m1.json sibling re-pins the searched knobs
+    _valid_art(knobs={"refill_frac": 0.25},
+               mesh={"n_devices": 4, "n_model": 1}).save(
+        tune_artifact.cache_path(tmp_path, "d4m1"))
+    got, status = on_remesh(cfg, 4)
+    assert status == "cache_hit" and got.refill_frac == 0.25
+
+    # a torn cache entry is a miss (stale), never a crash
+    tune_artifact.cache_path(tmp_path, "d2m1").write_text("torn{")
+    got, status = on_remesh(cfg, 2)
+    assert status == "stale" and got.refill_frac == 0.5
+
+
+def test_fleet_policy_prefers_tuned_shape(tmp_path):
+    """A per-topology artifact outranks the score policy: the searched
+    TP width is returned verbatim with policy='tuned' provenance."""
+    from crosscoder_tpu.resilience.fleet import FleetPolicy
+
+    p = _valid_art(mesh={"n_devices": 4, "n_model": 2}).save(
+        tune_artifact.cache_path(tmp_path, "d4m2"))
+    cfg = tiny_cfg(elastic_policy="fixed", tuned=str(tmp_path / "nope.json"))
+    choice = FleetPolicy(cfg).choose(4)
+    assert (choice.n_data, choice.n_model) == (2, 2)
+    assert choice.detail["policy"] == "tuned"
+    assert choice.detail["artifact"] == str(p)
+    # no artifact for this device count: falls through to the base policy
+    fallback = FleetPolicy(cfg).choose(8)
+    assert fallback.detail.get("policy") != "tuned"
+
+
+# ---------------------------------------------------------------------------
+# --tuned through the real CLI entry point
+# ---------------------------------------------------------------------------
+
+
+def _argv(tmp_path, tag, extra=()):
+    return [
+        "--data-source", "synthetic",
+        "--batch-size", "64",
+        "--buffer-mult", "4",
+        "--num-tokens", "1920",             # 30 steps
+        "--d-in", "16",
+        "--dict-size", "256",
+        "--seq-len", "17",
+        "--log-backend", "jsonl",
+        "--log-every", "10",
+        "--save-every", "10000",
+        "--checkpoint-dir", str(tmp_path / f"ckpt_{tag}"),
+        *extra,
+    ]
+
+
+@pytest.mark.slow
+def test_tuned_flag_round_trips_bitwise_through_main(tmp_path):
+    """`--tuned TUNED.json` must resolve to the SAME config — and the
+    same loss trajectory, bit for bit — as hand-passing the artifact's
+    knobs as explicit CLI flags."""
+    from crosscoder_tpu.train.main import main
+
+    p = _valid_art(knobs={"refill_frac": 0.25, "prefetch": False}).save(
+        tmp_path / "TUNED.json")
+    t_tuned = main(_argv(tmp_path, "tuned", ["--tuned", str(p)]))
+    t_hand = main(_argv(tmp_path, "hand", ["--refill-frac", "0.25",
+                                           "--prefetch", "false"]))
+
+    da, db = t_tuned.cfg.to_dict(), t_hand.cfg.to_dict()
+    for d in (da, db):
+        d.pop("tuned"), d.pop("checkpoint_dir")
+    assert da == db
+    assert t_tuned.cfg.tuned == str(p)
+
+    rows_a = [json.loads(ln) for ln in
+              (tmp_path / "ckpt_tuned" / "metrics.jsonl")
+              .read_text().splitlines()]
+    rows_b = [json.loads(ln) for ln in
+              (tmp_path / "ckpt_hand" / "metrics.jsonl")
+              .read_text().splitlines()]
+    assert [r["loss"] for r in rows_a] == [r["loss"] for r in rows_b]
+    assert len(rows_a) >= 2
+
+
+def test_from_cli_tuned_resolution_order(tmp_path):
+    """TUNED knobs land between --config-json and explicit flags: an
+    explicit flag wins over the artifact, the artifact over the json."""
+    p = _valid_art(knobs={"refill_frac": 0.25, "prefetch": False}).save(
+        tmp_path / "TUNED.json")
+    cj = tmp_path / "cfg.json"
+    cj.write_text(json.dumps({"refill_frac": 0.5, "d_in": 16}))
+    cfg = CrossCoderConfig.from_cli([
+        "--config-json", str(cj), "--tuned", str(p),
+        "--prefetch", "true",
+    ])
+    assert cfg.refill_frac == 0.25          # artifact beat config-json
+    assert cfg.prefetch is True             # explicit flag beat artifact
+    assert cfg.d_in == 16                   # untouched json field survives
+    # --tuned "" clears a json-pinned artifact path
+    cj.write_text(json.dumps({"tuned": str(p)}))
+    cfg = CrossCoderConfig.from_cli(["--config-json", str(cj),
+                                     "--tuned", ""])
+    assert cfg.tuned == "" and cfg.refill_frac == 0.5
+
+
+# ---------------------------------------------------------------------------
+# scripts/tune_report.py
+# ---------------------------------------------------------------------------
+
+
+def test_tune_report_renders_valid_artifact(tmp_path, capsys):
+    art = _valid_art(
+        predicted={"score": 123.4}, measured={"score": 117.0},
+        gate={"rule_set": "analysis.contracts.hlo_rules",
+              "checked": 3, "rejected": 1},
+        search={"axes": {"prefetch": [False, True]}, "n_candidates": 2,
+                "n_pruned_invalid": 0, "n_priced": 2, "top_k": 2,
+                "seed": 0, "calibration_steps": 6,
+                "candidates": [
+                    {"knobs": {"prefetch": False}, "gate": "pass",
+                     "predicted_score": 123.4, "measured_score": 117.0},
+                    {"knobs": {"prefetch": True}, "gate": "rejected"},
+                ]})
+    p = art.save(tmp_path / "TUNED.json")
+    mod = _load_script("tune_report")
+    assert mod.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "prefetch" in out and "rejected" in out
+    assert "d1m1" in out
+    # --json re-emits the validated artifact
+    assert mod.main([str(p), "--json"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out)["knobs"] == {"prefetch": False}
+
+
+@pytest.mark.parametrize("payload", [
+    "", "not json", json.dumps({"version": 1}),
+    json.dumps({**_valid_art().to_dict(), "knobs": {}}, default=str),
+])
+def test_tune_report_rejects_malformed(tmp_path, payload):
+    p = tmp_path / "TUNED.json"
+    p.write_text(payload)
+    mod = _load_script("tune_report")
+    assert mod.main([str(p)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# the real contracts gate (one compile-backed spot check)
+# ---------------------------------------------------------------------------
+
+
+def test_contracts_gate_passes_clean_data_plane_candidate():
+    """End-to-end gate over a real lowering: a data-plane knob assignment
+    must pass every HLO rule INCLUDING the tune-specific step-projection
+    identity (the stage-1 cost-sharing assumption)."""
+    from crosscoder_tpu.tune.calibrate import contracts_gate
+
+    cfg = tiny_cfg(refill_frac=0.25, prefetch=False)
+    ok, findings = contracts_gate(
+        cfg, knobs={"refill_frac": 0.25, "prefetch": False})
+    assert ok, [str(f) for f in findings]
