@@ -1,6 +1,6 @@
 """Benchmark: crosscoder pipeline throughput on one TPU chip.
 
-Thirteen sections (env ``BENCH_SECTIONS``, default all; progress on
+Fourteen sections (env ``BENCH_SECTIONS``, default all; progress on
 stderr).
 Output contract: stdout carries exactly ONE machine-parseable JSON line,
 guaranteed last and guaranteed **compact** (≤2 KB: headline, per-section
@@ -1445,6 +1445,53 @@ def section_tune() -> dict:
     return out
 
 
+def section_compile_cache() -> dict:
+    """The persistent AOT tier end to end (docs/SCALING.md "Persistent
+    compile cache"): two REAL processes run the serve warmup against one
+    ``compile_cache_dir`` — the first cold (populates the tier), the
+    second warm. Gates: the warm process performs ZERO XLA compiles
+    (the whole bucket ladder deserializes from disk) and its warmup
+    wall is ≤ 0.3× the cold process's."""
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_compile_cache_")
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+
+    def one(tag: str) -> dict:
+        proc = subprocess.run(
+            [sys.executable, "-m", "crosscoder_tpu.serve.warm_start",
+             "--cache-dir", cache_dir],
+            capture_output=True, text=True, cwd=here, env=env, timeout=900)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{tag} warm_start failed: {proc.stderr[-300:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])["warm_start"]
+
+    cold = one("cold")
+    warm = one("warm")
+    speedup = (cold["warmup_ms"] / warm["warmup_ms"]
+               if warm["warmup_ms"] else float("inf"))
+    out = {
+        "cold_warmup_ms": cold["warmup_ms"],
+        "warm_warmup_ms": warm["warmup_ms"],
+        "warm_vs_cold": round(warm["warmup_ms"] / cold["warmup_ms"], 4)
+        if cold["warmup_ms"] else None,
+        "cold_compiles": cold["compiles"],
+        "warm_compiles": warm["compiles"],
+        "disk_entries": warm["disk_entries"],
+        "warm_disk_hits": warm["disk_hits"],
+        "warm_speedup": round(speedup, 2),
+        "zero_compiles_warm_ok": warm["compiles"] == 0,
+        "warm_wall_gate_ok": warm["warmup_ms"] <= 0.3 * cold["warmup_ms"],
+        "workload": "tiny-LM serve warmup ladder, 2 processes, 1 cache dir",
+    }
+    log(f"[compile_cache] {out}")
+    return out
+
+
 # stdout-summary projection: per section, the fields worth the 2 KB line
 _SUMMARY_KEYS = {
     "step": ("acts_per_sec_chip", "vs_a100_step"),
@@ -1464,6 +1511,9 @@ _SUMMARY_KEYS = {
     "tune": ("tuned_acts_per_sec_chip", "default_acts_per_sec_chip",
              "tuned_vs_default", "serve_p99_tuned_ms",
              "serve_p99_default_ms", "tune_gate_ok", "aot_reuse_ok"),
+    "compile_cache": ("cold_warmup_ms", "warm_warmup_ms", "warm_vs_cold",
+                      "warm_compiles", "disk_entries",
+                      "zero_compiles_warm_ok", "warm_wall_gate_ok"),
 }
 _GATES = (("refill_overlap", "gate_ok"), ("quant", "quality_gate_ok"),
           ("obs", "overhead_gate_ok"), ("e2e", "loss_finite"),
@@ -1471,7 +1521,9 @@ _GATES = (("refill_overlap", "gate_ok"), ("quant", "quality_gate_ok"),
           ("elastic", "autoscale_bitwise_equal"),
           ("fleet", "fleet_gate_ok"),
           ("serve", "serve_gate_ok"), ("serve", "zero_compiles_ok"),
-          ("tune", "tune_gate_ok"), ("tune", "aot_reuse_ok"))
+          ("tune", "tune_gate_ok"), ("tune", "aot_reuse_ok"),
+          ("compile_cache", "zero_compiles_warm_ok"),
+          ("compile_cache", "warm_wall_gate_ok"))
 
 
 def _compact(headline: dict, results: dict) -> dict:
@@ -1567,7 +1619,7 @@ def _run_sections() -> dict:
     sections = os.environ.get(
         "BENCH_SECTIONS",
         "step,matrix,configs,e2e,refill_overlap,harvest,quant,obs,dash,"
-        "elastic,fleet,serve,tune"
+        "elastic,fleet,serve,tune,compile_cache"
     ).split(",")
     results: dict = {}
     for name, fn in (("step", section_step), ("matrix", section_matrix),
@@ -1580,7 +1632,8 @@ def _run_sections() -> dict:
                      ("elastic", section_elastic),
                      ("fleet", section_fleet),
                      ("serve", section_serve),
-                     ("tune", section_tune)):
+                     ("tune", section_tune),
+                     ("compile_cache", section_compile_cache)):
         if name not in sections:
             continue
         try:

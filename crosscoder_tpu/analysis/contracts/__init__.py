@@ -1,10 +1,11 @@
 """Static correctness plane: contract engine + rule packs.
 
-Three rule families, each a pure function of a prebuilt context:
+Four rule families, each a pure function of a prebuilt context:
 
 - ``hlo_rules``     — AOT-lowered step HLO / jaxpr contracts (StepContext)
 - ``pallas_safety`` — Pallas kernel BlockSpec/VMEM/race analysis (PallasContext)
 - ``ast_lints``     — repo-wide source invariants (SourceContext)
+- ``cache_keys``    — persistent compile-cache key completeness (CacheKeyContext)
 
 ``scripts/analyze.py`` is the CLI; ``mutations`` carries one seeded
 violation per rule so the checker itself is checked.
@@ -13,6 +14,8 @@ violation per rule so the checker itself is checked.
 from crosscoder_tpu.analysis.contracts.ast_lints import (AST_RULES,
                                                          SourceContext,
                                                          build_source_context)
+from crosscoder_tpu.analysis.contracts.cache_keys import (
+    CACHE_RULES, CacheKeyContext, build_cache_key_context)
 from crosscoder_tpu.analysis.contracts.engine import (Finding, Report, Rule,
                                                       run_rules)
 from crosscoder_tpu.analysis.contracts.hlo_rules import (HLO_RULES,
@@ -33,5 +36,6 @@ __all__ = [
     "check_compiled_text",
     "PALLAS_RULES", "PallasContext", "run_kernel_probes", "vmem_summary",
     "AST_RULES", "SourceContext", "build_source_context",
+    "CACHE_RULES", "CacheKeyContext", "build_cache_key_context",
     "ALL_RULES", "MUTATIONS", "run_mutation",
 ]

@@ -52,7 +52,7 @@ GATE_RE = re.compile(r"^CROSSCODER_[A-Z0-9_]+_PALLAS$")
 # metric-key surface (kept in lockstep with the docstring of
 # scripts/check_metric_keys.py, which re-exports these)
 NAMESPACES = ("resilience/", "perf/", "comm/", "harvest/", "tenant/",
-              "serve/", "tune/")
+              "serve/", "tune/", "compile/")
 REFERENCE_KEYS = {
     "loss", "l2_loss", "l1_loss", "l0_loss", "l1_coeff", "lr",
     "explained_variance",

@@ -1,0 +1,93 @@
+"""Persistent compile-cache key contracts (CacheKeyContext).
+
+The disk tier of :mod:`crosscoder_tpu.utils.compile_cache` keys every
+stored executable by a digest of the step-knob projection
+(:func:`~crosscoder_tpu.utils.compile_cache.step_digest` over
+:data:`crosscoder_tpu.tune.lattice.STEP_FIELDS`). If a knob that changes
+the lowered step program ever fails to feed that digest, two different
+programs collide on one cache entry and a warm start silently loads the
+WRONG executable — the one failure mode the cache is never allowed to
+have (docs/SCALING.md "Persistent compile cache").
+
+``cache-key-completeness`` closes that hole structurally: for every
+field in ``STEP_FIELDS`` it perturbs the base config dict with a
+sentinel value and asserts the digest forks. A field whose perturbation
+leaves the digest unchanged is a finding; so is a ``STEP_FIELDS`` entry
+that no longer exists on the config (key-surface drift). The rule is
+pure data — no jax, no lowering — so it runs in milliseconds and ships
+the mandatory mutation self-test (a digest that ignores one field).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from crosscoder_tpu.analysis.contracts.engine import Finding, Rule
+
+
+@dataclass
+class CacheKeyContext:
+    """Inputs of the key-completeness check: the authoritative knob set,
+    a base config dict, and the digest the disk tier actually uses."""
+
+    kind: str = "cache_keys"
+    fields: frozenset[str] = frozenset()
+    base_cfg: dict[str, Any] = field(default_factory=dict)
+    digest_fn: Callable[[dict[str, Any]], str] = lambda d: ""
+
+
+def build_cache_key_context() -> CacheKeyContext:
+    """Context over the REAL surfaces: ``CrossCoderConfig()`` defaults,
+    ``tune.lattice.STEP_FIELDS``, and ``compile_cache.step_digest``."""
+    from crosscoder_tpu.config import CrossCoderConfig
+    from crosscoder_tpu.tune.lattice import STEP_FIELDS
+    from crosscoder_tpu.utils import compile_cache
+
+    return CacheKeyContext(
+        fields=STEP_FIELDS,
+        base_cfg=CrossCoderConfig().to_dict(),
+        digest_fn=compile_cache.step_digest,
+    )
+
+
+def _is_cache_ctx(ctx: Any) -> bool:
+    return getattr(ctx, "kind", "") == "cache_keys"
+
+
+# a value no knob legitimately takes, serializable by the projection's
+# ``default=str`` fallback — guaranteed different from any real setting
+_SENTINEL = ("__cache_key_mutant__",)
+
+
+def _check_completeness(ctx: CacheKeyContext) -> list[Finding]:
+    out: list[Finding] = []
+    base_digest = ctx.digest_fn(dict(ctx.base_cfg))
+    for name in sorted(ctx.fields):
+        if name not in ctx.base_cfg:
+            out.append(Finding(
+                rule="cache-key-completeness", location=name,
+                message=f"STEP_FIELDS names '{name}' but the config has "
+                        f"no such field — the key surface and the config "
+                        f"have drifted apart",
+            ))
+            continue
+        perturbed = dict(ctx.base_cfg)
+        perturbed[name] = _SENTINEL
+        if ctx.digest_fn(perturbed) == base_digest:
+            out.append(Finding(
+                rule="cache-key-completeness", location=name,
+                message=f"perturbing step knob '{name}' does not change "
+                        f"the disk-cache digest — two different step "
+                        f"programs would collide on one persisted "
+                        f"executable (a warm start could load the wrong "
+                        f"program)",
+            ))
+    return out
+
+
+CACHE_RULES: list[Rule] = [
+    Rule("cache-key-completeness",
+         "every step-shaping knob forks the persistent compile-cache key",
+         _is_cache_ctx, _check_completeness),
+]

@@ -219,11 +219,15 @@ KNOB_OFF_LATTICE: tuple[tuple[str, dict[str, Any]], ...] = (
                    fleet_max_buckets=4, checkpoint_dir="/tmp/ckpt")),
     ("serve", dict(serve="on", serve_max_batch=8, serve_max_wait_ms=2.0,
                    serve_queue=32, serve_shed_ms=50.0)),
+    ("compile_cache", dict(compile_cache_dir="/tmp/compile_cache_contract",
+                           compile_cache_max_bytes=1 << 20,
+                           compile_cache_verify="strict")),
     ("all_knobs", dict(quant_buffer=True, quant_block=8, obs="on",
                        harvest_runtime="paged", page_size=16, seq_len=1024,
                        guard_loss=True, log_backend="jsonl",
                        refill_overlap="on", refill_dispatch_batch=8,
                        elastic="on", elastic_grow="on", serve="on",
+                       compile_cache_dir="/tmp/compile_cache_contract",
                        checkpoint_dir="/tmp/ckpt")),
 )
 

@@ -18,6 +18,8 @@ from typing import Any, Callable
 
 from crosscoder_tpu.analysis.contracts.ast_lints import (AST_RULES,
                                                          SourceContext)
+from crosscoder_tpu.analysis.contracts.cache_keys import (CACHE_RULES,
+                                                          CacheKeyContext)
 from crosscoder_tpu.analysis.contracts.engine import Report, Rule, run_rules
 from crosscoder_tpu.analysis.contracts.hlo_rules import (HLO_RULES,
                                                          StepContext,
@@ -27,7 +29,7 @@ from crosscoder_tpu.analysis.contracts.pallas_safety import (PALLAS_RULES,
                                                              PallasContext,
                                                              SpecView)
 
-ALL_RULES: list[Rule] = HLO_RULES + PALLAS_RULES + AST_RULES
+ALL_RULES: list[Rule] = HLO_RULES + PALLAS_RULES + AST_RULES + CACHE_RULES
 
 _CLEAN_HLO = """\
 module @jit_step {
@@ -254,6 +256,26 @@ def _mut_unused_import() -> SourceContext:
     return _src_ctx({"crosscoder_tpu/bad.py": "import os\nx = 1\n"})
 
 
+def _mut_cache_key() -> CacheKeyContext:
+    # a digest that ignores 'seed': perturbing it cannot fork the key,
+    # so two differently-seeded step programs would share one cache entry
+    import hashlib
+    import json
+
+    fields = frozenset({"batch_size", "dict_size", "seed"})
+
+    def leaky_digest(d):
+        proj = {k: d.get(k) for k in sorted(fields - {"seed"})}
+        blob = json.dumps(proj, sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    return CacheKeyContext(
+        fields=fields,
+        base_cfg={"batch_size": 32, "dict_size": 64, "seed": 0},
+        digest_fn=leaky_digest,
+    )
+
+
 MUTATIONS: dict[str, Callable[[], Any]] = {
     "hlo-knob-off-identity": _mut_identity,
     "hlo-refill-overlap-off-identity": _mut_refill_overlap,
@@ -281,6 +303,7 @@ MUTATIONS: dict[str, Callable[[], Any]] = {
     "lint-span-taxonomy": _mut_span,
     "lint-metric-keys": _mut_metric_key,
     "lint-unused-imports": _mut_unused_import,
+    "cache-key-completeness": _mut_cache_key,
 }
 
 

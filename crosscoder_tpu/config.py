@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any
@@ -544,6 +545,22 @@ class CrossCoderConfig:
                                     # before explicit flags); the elastic
                                     # controller re-checks it on remesh.
                                     # Empty = no tuner involvement.
+    # --- persistent AOT executable cache (docs/SCALING.md "Persistent
+    # compile cache"). Empty dir (default) = tier off, ZERO-COST: the
+    # compiled step HLO and transfer counts are byte-identical to a
+    # build without it (tests/test_compile_cache_disk.py).
+    compile_cache_dir: str = ""     # directory for serialized AOT
+                                    # executables + cost sidecars; serve
+                                    # warmup, elastic remesh/grow, fleet
+                                    # admission, and tune calibration
+                                    # deserialize instead of compiling
+    compile_cache_max_bytes: int = 1 << 30   # byte cap on the disk tier;
+                                    # least-recently-used entries evict
+                                    # past it (compile/evictions)
+    compile_cache_verify: str = "off"   # off | strict: strict re-lowers
+                                    # on every disk load and rejects an
+                                    # entry whose stored HLO hash differs
+                                    # from the live lowering
 
     # master-weight/Adam-moment dtype. fp32 (default) is a quality upgrade
     # over the reference; "bf16" reproduces the reference exactly (its params
@@ -944,6 +961,27 @@ class CrossCoderConfig:
                 f"refresh every N steps, 0 = follow log_every), got "
                 f"{self.aux_mask_every}"
             )
+        _check_choice("compile_cache_verify", self.compile_cache_verify,
+                      ("off", "strict"))
+        if self.compile_cache_max_bytes <= 0:
+            raise ValueError(
+                f"compile_cache_max_bytes must be > 0 (the disk tier "
+                f"needs a positive byte cap; disable the tier with "
+                f"compile_cache_dir='' instead), got "
+                f"{self.compile_cache_max_bytes}"
+            )
+        if self.compile_cache_dir:
+            # fail at config time, not mid-warmup: the tier directory
+            # must be creatable/writable on this host
+            try:
+                os.makedirs(self.compile_cache_dir, exist_ok=True)
+            except OSError as e:
+                raise ValueError(
+                    f"compile_cache_dir {self.compile_cache_dir!r} is not "
+                    f"creatable ({e}); point it at writable storage or "
+                    f"leave it empty to disable the persistent compile "
+                    f"cache"
+                ) from e
 
     # --- derived quantities -------------------------------------------------
     @property

@@ -1115,15 +1115,16 @@ def paged_capture_aot(
     )
     key = ("paged_capture", cfg, cap_pairs, n_scan, page_size, use_kernel,
            pad_mode, str(out_dtype), chunk.tokens.shape, chunk.doc_idx.shape,
-           str(chunk.tokens.dtype))
-    compiled = compile_cache.aot_get(
-        key,
-        lambda: _paged_multi_impl.lower(
+           str(chunk.tokens.dtype), len(args[0]))
+    def lower():
+        return _paged_multi_impl.lower(
             *args, cfg=cfg, capture=cap_pairs, n_scan=n_scan,
             page_size=page_size, use_kernel=use_kernel, pad_mode=pad_mode,
             out_dtype=out_dtype,
-        ).compile(),
-        on_build=on_build,
+        )
+
+    compiled = compile_cache.aot_get(
+        key, lambda: lower().compile(), on_build=on_build, lower=lower,
     )
     return compiled(*args)
 

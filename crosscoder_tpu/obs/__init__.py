@@ -75,12 +75,15 @@ class Observability:
         return ns / 1e9
 
     # -- compile/comm observability -------------------------------------
-    def observe_step(self, key: str, jit_fn: Any) -> Any:
+    def observe_step(self, key: str, jit_fn: Any, *,
+                     disk_scope: Any = None) -> Any:
         """Wrap a jitted step variant so its compilation is measured and
-        reported (utils.compile_cache.observed)."""
+        reported (utils.compile_cache.observed). ``disk_scope`` keys the
+        persistent AOT tier when ``cfg.compile_cache_dir`` is set."""
         from crosscoder_tpu.utils import compile_cache
 
-        return compile_cache.observed(jit_fn, key, self)
+        return compile_cache.observed(jit_fn, key, self,
+                                      disk_scope=disk_scope)
 
     def on_compile(self, key: str, compiled: Any, wall_s: float) -> None:
         """Report one compile event + the compiled program's collective

@@ -41,6 +41,8 @@ honest-tail-latency surface the bench's SLO gate reads.
 
 from __future__ import annotations
 
+import sys
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -173,6 +175,15 @@ class InferenceEngine:
         self._next_id = 0
         self._compiles = 0
         self._warm_compiles = 0
+        # compile accounting is touched from warmup's worker threads
+        self._compile_lock = threading.Lock()
+        self._warm_tl = threading.local()
+        # persistent AOT tier (cfg.compile_cache_dir; docs/SCALING.md
+        # "Persistent compile cache"): a fresh replica's warmup
+        # deserializes the bucket ladder instead of compiling it
+        from crosscoder_tpu.utils import compile_cache
+
+        compile_cache.configure(cfg, registry=self.registry)
         # params are fixed per engine; their shape/dtype signature keys
         # the encode executables alongside the batch bucket
         self._cc_sig = tuple(sorted(
@@ -199,7 +210,11 @@ class InferenceEngine:
         return rid in self._shed_ids
 
     def _on_build(self, key) -> None:
-        self._compiles += 1
+        with self._compile_lock:
+            self._compiles += 1
+            n = getattr(self._warm_tl, "n", None)
+            if n is not None:     # inside a warmup worker: per-bucket tally
+                self._warm_tl.n = n + 1
         self.registry.count("serve/compiles")
 
     def _shed(self, rid: int | None, reason: str):
@@ -382,12 +397,16 @@ class InferenceEngine:
                            fused=fused, pair=self._pair)
             key = ("serve_encode", b, tuple(caps.shape), str(caps.dtype),
                    self._cc_sig, tuple(sorted(statics.items())))
-            compiled = compile_cache.aot_get(
-                key,
-                lambda: serve_step.encode_topk_diff.lower(
+
+            def lower():
+                return serve_step.encode_topk_diff.lower(
                     self._cc_params, caps, lengths, norm, **statics
-                ).compile(),
-                on_build=self._on_build,
+                )
+
+            compiled = compile_cache.aot_get(
+                key, lambda: lower().compile(),
+                on_build=self._on_build, lower=lower,
+                topology=f"devices={jax.device_count()}",
             )
             out = compiled(self._cc_params, caps, lengths, norm)
             vals, idx, diff = (np.asarray(jax.device_get(t)) for t in out)
@@ -398,17 +417,38 @@ class InferenceEngine:
         return vals, idx, diff, prefill_ms, encode_ms
 
     def warmup(self) -> int:
-        """Build every bucket's prefill + encode executable ahead of
-        traffic (full-length synthetic chunks — the exact steady-state
-        shapes). Freezes the compile baseline: after this,
+        """Build — or deserialize from the persistent tier
+        (``cfg.compile_cache_dir``) — every bucket's prefill + encode
+        executable ahead of traffic (full-length synthetic chunks — the
+        exact steady-state shapes). Buckets warm CONCURRENTLY: disk
+        loads and residual compiles overlap across a small thread pool,
+        so warmup wall is bounded by the slowest bucket, not the ladder
+        sum (jax dispatch and the AOT memo are both thread-safe; equal
+        keys coalesce onto one build). The readiness log stays in
+        deterministic ladder order regardless of completion order.
+        Freezes the compile baseline: after this,
         :attr:`compiles_after_warmup` must stay 0 (asserted by the bench
         serve leg and scripts/serve_smoke.sh)."""
+        from concurrent.futures import ThreadPoolExecutor
+
         S = self.cfg.seq_len
-        for b in self.buckets:
-            tokens = np.ones((b, S), np.int32)
-            lengths = np.full(b, S, np.int64)
-            chunk = pack_chunk(tokens, lengths, n_rows=b)
+
+        def _warm_one(b: int) -> tuple[float, int]:
+            self._warm_tl.n = 0
+            t0 = time.perf_counter()
+            chunk = pack_chunk(np.ones((b, S), np.int32),
+                               np.full(b, S, np.int64), n_rows=b)
             self._run_chunk(chunk, b)
+            return (time.perf_counter() - t0) * 1e3, self._warm_tl.n
+
+        with ThreadPoolExecutor(
+                max_workers=min(8, len(self.buckets)),
+                thread_name_prefix="serve-warmup") as pool:
+            timings = list(pool.map(_warm_one, self.buckets))
+        for b, (ms, n) in zip(self.buckets, timings):
+            print(f"[crosscoder_tpu] serve: warm bucket={b} "
+                  f"({ms:.0f} ms, {n} compile(s))",
+                  file=sys.stderr, flush=True)
         self._warm_compiles = self._compiles
         return self._warm_compiles
 

@@ -1,0 +1,75 @@
+"""Warm-start probe: one serve warmup against a persistent compile cache.
+
+``python -m crosscoder_tpu.serve.warm_start --cache-dir D`` builds the
+tiny-LM serving stack (:func:`crosscoder_tpu.serve.smoke.build_engine`)
+with ``compile_cache_dir=D``, runs :meth:`InferenceEngine.warmup`, and
+prints ONE JSON line to stdout::
+
+    {"warm_start": {"warmup_ms": ..., "compiles": N, "disk_hits": N,
+                    "disk_misses": N, "disk_entries": N,
+                    "zero_compiles": bool}}
+
+Run it twice against the same directory from two separate processes and
+the second run must report ``compiles == 0`` — the whole bucket ladder
+deserializes from disk (docs/SCALING.md "Persistent compile cache").
+That two-process pairing is the bench ``compile_cache`` leg, the
+tier-1 warm-start smoke, and the cross-process test in
+tests/test_compile_cache_disk.py; keeping the probe here means all
+three measure the same code path.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def run(cache_dir: str, *, serve_max_batch: int = 8,
+        seq_len: int = 16, **cfg_overrides) -> dict:
+    """Build + warm one engine against ``cache_dir``; return the report
+    dict (importable form of the CLI, used by bench and tests)."""
+    from crosscoder_tpu.serve.smoke import build_engine
+    from crosscoder_tpu.utils import compile_cache
+
+    eng, _cfg, _lm_cfg, _params, _cc = build_engine(
+        serve_max_batch=serve_max_batch, seq_len=seq_len,
+        compile_cache_dir=cache_dir, **cfg_overrides)
+    t0 = time.perf_counter()
+    n_compiles = eng.warmup()
+    warmup_ms = (time.perf_counter() - t0) * 1e3
+    stats = compile_cache.disk_stats()
+    return {
+        "warmup_ms": round(warmup_ms, 1),
+        "compiles": int(n_compiles),
+        "disk_hits": int(stats.get("disk_hit", 0)),
+        "disk_misses": int(stats.get("disk_miss", 0)),
+        "disk_entries": compile_cache.disk_entry_count(),
+        "zero_compiles": int(n_compiles) == 0,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cache-dir", required=True,
+                    help="persistent compile cache directory "
+                         "(cfg.compile_cache_dir)")
+    ap.add_argument("--expect-zero-compiles", action="store_true",
+                    help="exit nonzero unless the warmup performed zero "
+                         "XLA compiles (the warm-process assertion)")
+    ns = ap.parse_args(argv)
+
+    report = run(ns.cache_dir)
+    print(  # contracts: allow(lint-no-stdout-print) — one-line report
+        json.dumps({"warm_start": report}), flush=True)
+    if ns.expect_zero_compiles and not report["zero_compiles"]:
+        print(f"[warm_start] FAIL: expected zero compiles, got "
+              f"{report['compiles']}", file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -248,6 +248,10 @@ class FleetScheduler:
         self.buffer = buffer
         self.logger = logger
         self.registry = registry
+        # persistent AOT tier (cfg.compile_cache_dir): bucket/cohort
+        # admission compiles dedupe across fleet processes — one
+        # compiles (claim-by-rename leader), peers deserialize
+        compile_cache.configure(cfg, registry=registry)
         self._checkpoint = checkpoint and bool(cfg.checkpoint_dir)
         self._raw_serving = hasattr(buffer, "next_raw_for")
         if not self._raw_serving and not hasattr(buffer, "next_for"):

@@ -49,7 +49,7 @@ from crosscoder_tpu.obs import trace
 from crosscoder_tpu.resilience.elastic import PeerLoss
 from crosscoder_tpu.train import schedules
 from crosscoder_tpu.train.state import TrainState, init_train_state, make_optimizer
-from crosscoder_tpu.utils import pipeline
+from crosscoder_tpu.utils import compile_cache, pipeline
 from crosscoder_tpu.utils.logging import MetricsLogger, ResilienceCounters, source_tag
 
 
@@ -460,6 +460,15 @@ class Trainer:
             from crosscoder_tpu.obs import Observability
 
             self._obs = Observability(cfg, mesh=self.mesh)
+        # persistent AOT disk tier (cfg.compile_cache_dir; docs/SCALING.md
+        # "Persistent compile cache"): off (the default) configures
+        # nothing and every compile path below stays byte-identical
+        compile_cache.configure(
+            cfg, registry=self._obs.registry if self._obs is not None
+            else None)
+        # batch dtype actually served this run — the remesh prewarm keys
+        # its target-topology avals with it (None until the first step)
+        self._batch_dtype = None
 
         self._tx = tx = make_optimizer(cfg, schedules.lr_schedule(cfg))
         # n_data pins the quant_grads error-feedback residual shapes to
@@ -577,23 +586,41 @@ class Trainer:
     def step_counter(self) -> int:
         return int(self.state.step)
 
-    def _wrap_step(self, key: tuple[bool, bool, bool], fn: Callable) -> Callable:
-        """Compile-event observation for one step variant (obs on only;
-        with obs off the jitted fn is returned untouched, so the off path
-        calls exactly what it always called)."""
-        if self._obs is None:
-            return fn
-        from crosscoder_tpu.utils.compile_cache import variant_key
-
-        # the encoder tier traced into this variant (trace-time static):
-        # aux-on steps keep the dense encode (the h-residual escape
-        # hatch), so the enc tag follows the aux key
+    def _variant_label(self, key: tuple[bool, bool, bool]) -> str:
+        """The canonical compile-event label for one step variant,
+        including the encoder tier traced into it (trace-time static):
+        aux-on steps keep the dense encode (the h-residual escape
+        hatch), so the enc tag follows the aux key."""
         enc = "dense"
         if not (key[1] and self.cfg.aux_k > 0) and cc.use_fused_encoder(
                 self.cfg, self.cfg.batch_size):
             enc = "fused-int8" if self.cfg.quant_encoder else "fused"
-        label = variant_key(*key, enc=enc)
-        return self._obs.observe_step(label, fn)
+        return compile_cache.variant_key(*key, enc=enc)
+
+    def _compile_scope(self, mesh=None):
+        """``(mesh topology, step-knob projection hash)`` — the scope
+        half of this trainer's persistent compile-cache keys; ``None``
+        (no disk lookups) when the tier is off."""
+        if not compile_cache.disk_enabled():
+            return None
+        mesh = self.mesh if mesh is None else mesh
+        return (tuple(sorted(mesh.shape.items())),
+                compile_cache.step_digest(self.cfg.to_dict()))
+
+    def _wrap_step(self, key: tuple[bool, bool, bool], fn: Callable) -> Callable:
+        """Compile-event observation + persistent-cache scoping for one
+        step variant. With obs off AND the disk tier off (the default)
+        the jitted fn is returned untouched, so that path calls exactly
+        what it always called."""
+        if self._obs is None and not compile_cache.disk_enabled():
+            return fn
+        label = self._variant_label(key)
+        scope = self._compile_scope()
+        if self._obs is not None:
+            return self._obs.observe_step(label, fn, disk_scope=scope)
+        # disk tier without the obs plane: spans go to the (null) global
+        # tracer and no compile event is reported — but warm starts work
+        return compile_cache.observed(fn, label, None, disk_scope=scope)
 
     def _device_scale(self) -> jax.Array:
         """Replicated per-source scale, re-uploaded only when the factors'
@@ -790,6 +817,10 @@ class Trainer:
             self._obs.add_blocked_ns(time.perf_counter_ns() - t_wait)
         else:
             (batch, scale), ticket = self._next_batch()
+        if self._batch_dtype is None:
+            # the dtype the stream actually serves — the remesh prewarm
+            # keys its target-topology batch aval with it
+            self._batch_dtype = batch.dtype
         # the resample + step launches run under this step's reserved
         # launch slot on ticketed (multi-process) runs — a nullcontext
         # otherwise. Lock order: turn (outermost) → dispatch lock → guard;
@@ -1049,6 +1080,76 @@ class Trainer:
                   f"quiesce ({type(e).__name__}: {e}); saving anyway"[:400],
                   flush=True, file=sys.stderr)
 
+    def _start_remesh_prewarm(self) -> threading.Thread | None:
+        """Kick off the background compile-prewarm for the post-shrink
+        topology (persistent tier on only — with ``compile_cache_dir``
+        unset this returns ``None`` and the remesh path is byte-for-byte
+        the pre-tier sequence). The thread runs concurrently with the
+        quiesce/drain below and MUST be joined before the backend reset:
+        it lowers against the dying backend's devices."""
+        if not compile_cache.disk_enabled():
+            return None
+        t = threading.Thread(
+            target=self._prewarm_for_local_mesh,
+            args=(list(self._step_fns),),
+            name="remesh-prewarm", daemon=True)
+        t.start()
+        return t
+
+    def _prewarm_for_local_mesh(self, keys: list) -> None:
+        """Best-effort: compile the step variants this run uses for the
+        survivor-local mesh — the topology ``_elastic.shrink()`` will
+        produce — and persist them to the disk tier, so the re-meshed
+        world's first step deserializes instead of compiling (the
+        compile falls out of the ``remesh_ms`` downtime window). Every
+        failure is swallowed: prewarm may only ever remove compile time,
+        never add faults; a wrong topology guess just leaves an unused
+        entry behind."""
+        try:
+            cfg = self.cfg
+            disk = compile_cache.disk_cache()
+            mesh = mesh_lib.make_mesh(devices=jax.local_devices())
+            template = jax.eval_shape(
+                lambda k: init_train_state(
+                    k, cfg, self._tx,
+                    n_data=int(mesh.shape.get("data", 1))),
+                jax.random.key(cfg.seed))
+            shardings = mesh_lib.state_shardings(
+                mesh, template, cfg.shard_sources)
+            state_sh = jax.tree_util.tree_map(
+                lambda s, sh: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=sh),
+                template, shardings)
+            batch = jax.ShapeDtypeStruct(
+                (cfg.batch_size, cfg.n_sources, cfg.d_in),
+                self._batch_dtype or jnp.float32,
+                sharding=mesh_lib.batch_sharding(mesh))
+            scale = jax.ShapeDtypeStruct(
+                (cfg.n_sources,), jnp.float32,
+                sharding=NamedSharding(mesh, PartitionSpec()))
+            scope = self._compile_scope(mesh)
+            for key in keys:
+                label = self._variant_label(key)
+                dk = compile_cache.observed_digest(
+                    label, scope, (state_sh, batch, scale))
+                if dk is None or disk is None or disk.has(dk):
+                    continue
+                fn = make_train_step(
+                    cfg, mesh, self._tx, shardings,
+                    with_metrics=key[0], aux_on=key[1],
+                    mask_refresh=key[2])
+                lowered = fn.lower(state_sh, batch, scale)
+                disk.store(dk, lowered.compile(), variant=label,
+                           topology=str(dict(mesh.shape)),
+                           lower=lambda lw=lowered: lw)
+                print(f"[crosscoder_tpu] elastic: prewarmed {label} for "
+                      f"mesh {dict(mesh.shape)}",
+                      file=sys.stderr, flush=True)
+        except Exception as e:
+            print(f"[crosscoder_tpu] elastic: remesh prewarm skipped "
+                  f"({type(e).__name__}: {e})"[:300],
+                  file=sys.stderr, flush=True)
+
     def _remesh_and_resume(self, cause: BaseException) -> None:
         """Survivor recovery (cfg.elastic; docs/resilience.md "Elastic
         membership"): quiesce every consumer of the dying backend, shrink
@@ -1063,6 +1164,12 @@ class Trainer:
             print(f"[crosscoder_tpu] elastic: peer loss confirmed "
                   f"({type(cause).__name__}); re-meshing over survivors",
                   flush=True, file=sys.stderr)
+            # 0. prewarm (persistent tier only): compile the target
+            #    topology's step variants to disk IN THE BACKGROUND while
+            #    the quiesce below drains — the post-rebuild first step
+            #    then deserializes, and compile wall falls out of the
+            #    remesh downtime window
+            prewarm = self._start_remesh_prewarm()
             # 1. quiesce: nothing may touch the dying backend past here.
             #    The prefetched batch (if any) belongs to the dead world;
             #    its production may itself have died on the torn collective.
@@ -1088,6 +1195,10 @@ class Trainer:
                     self.checkpointer.wait()  # land any background write
                 except Exception:
                     pass
+            if prewarm is not None:
+                # joined BEFORE the reset: the prewarm thread lowers
+                # against the dying backend's devices
+                prewarm.join(timeout=300.0)
             # 2. shrink: tear down the distributed runtime, bump the mesh
             #    epoch, reset the backend (all device buffers die here)
             mesh = self._elastic.shrink()
@@ -1163,6 +1274,17 @@ class Trainer:
         with trace.span("grow"):
             print(f"[crosscoder_tpu] elastic: rejoin candidates debounced; "
                   f"growing at step {step}", flush=True, file=sys.stderr)
+            if compile_cache.disk_enabled():
+                # the wide mesh is not locally constructible before the
+                # rendezvous (its devices don't exist here yet), so no
+                # compile prewarm — warm starts come from entries a
+                # previous wide-world run persisted; the post-rebuild
+                # lookups deserialize on hit exactly like the shrink path
+                n = compile_cache.disk_entry_count()
+                print(f"[crosscoder_tpu] elastic: persistent compile "
+                      f"cache holds {n} entr{'y' if n == 1 else 'ies'} "
+                      f"for the post-grow warm start",
+                      file=sys.stderr, flush=True)
             # 1. quiesce, exactly like the shrink path: invalidate stale
             #    tickets first, then drain every consumer of the backend
             #    that is about to be reset

@@ -51,7 +51,13 @@ def tune(base_cfg: Any, objective: str = "train", *,
     """
     import jax
 
+    from crosscoder_tpu.utils import compile_cache
+
     reg = registry if registry is not None else MetricsRegistry()
+    # persistent AOT tier: a re-run of a previously priced lattice
+    # answers stage-1 costs from disk sidecars and deserializes the
+    # calibration step executables instead of re-compiling them
+    compile_cache.configure(base_cfg, registry=reg)
     measure = measure if measure is not None else measure_window
     gate = gate if gate is not None else contracts_gate
     if n_devices is None:

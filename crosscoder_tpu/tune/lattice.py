@@ -177,14 +177,19 @@ def _step_cost(cand: Candidate, n_devices: int) -> dict[str, float]:
         mesh = mesh_lib.make_mesh(devices=jax.devices()[:1])
         return comm_model._compile_train_step(cfg, mesh)
 
-    compiled = compile_cache.aot_get(key, build)
-    cost = compile_cache.cost_of(key) or compile_cache.record_cost(
-        key, compiled)
+    # disk-first (cfg.compile_cache_dir): a previously priced signature
+    # answers from the persisted cost sidecar without compiling — or
+    # even deserializing — anything
+    cost = compile_cache.cost_of(key)
+    comm = compile_cache.collectives_of(key)
+    if cost is None or comm is None:
+        compiled = compile_cache.aot_get(key, build)
+        cost = (cost or compile_cache.cost_of(key)
+                or compile_cache.record_cost(key, compiled))
+        if comm is None:
+            comm = comm_model.collective_bytes(compiled.as_text())
     n_model = max(1, int(cfg.model_axis_size))
-    profile = comm_model.CommProfile(
-        "tune_step", n_devices, n_model,
-        comm_model.collective_bytes(compiled.as_text()),
-    )
+    profile = comm_model.CommProfile("tune_step", n_devices, n_model, comm)
     n_data = max(1, n_devices // n_model)
     return {
         "flops": cost.get("flops", 0.0),

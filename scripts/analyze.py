@@ -65,8 +65,10 @@ def _allow_set(args: argparse.Namespace) -> frozenset[str]:
 
 
 def build_report(args: argparse.Namespace):
-    from crosscoder_tpu.analysis.contracts import (AST_RULES, HLO_RULES,
-                                                   PALLAS_RULES, Report,
+    from crosscoder_tpu.analysis.contracts import (AST_RULES, CACHE_RULES,
+                                                   HLO_RULES, PALLAS_RULES,
+                                                   Report,
+                                                   build_cache_key_context,
                                                    build_source_context,
                                                    build_step_context,
                                                    run_kernel_probes,
@@ -76,6 +78,8 @@ def build_report(args: argparse.Namespace):
     if not args.skip_lints:
         print("analyze: AST lints ...", file=sys.stderr)
         report.merge(run_rules(AST_RULES, build_source_context(), allow))
+        print("analyze: compile-cache key completeness ...", file=sys.stderr)
+        report.merge(run_rules(CACHE_RULES, build_cache_key_context(), allow))
     if not args.skip_pallas:
         print("analyze: Pallas kernel probes ...", file=sys.stderr)
         pctx = run_kernel_probes()

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Render a persistent compile-cache directory as a readable table.
+
+``python scripts/compile_cache_report.py <cache_dir>`` prints one row
+per persisted executable — digest, variant, mesh topology, bytes, age,
+last-used — plus the tier totals (entry count, total bytes vs the byte
+cap recorded in no manifest, hit/eviction provenance lives in the run's
+metrics stream instead), so an operator can answer "what warm starts
+does this directory buy" from the terminal. Exits nonzero on a
+malformed manifest (unreadable, non-JSON, ill-typed schema), mirroring
+``scripts/tune_report.py``, so CI and drivers can gate on artifact
+validity. The check here is deliberately STRICTER than the runtime's:
+:class:`~crosscoder_tpu.utils.compile_cache.DiskCache` treats the
+manifest as advisory and shrugs off corruption (the cache must never be
+fatal), while this report exists precisely to surface it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_manifest(root: str) -> dict:
+    """Strict manifest parse. Raises ValueError on anything the runtime
+    would silently tolerate: missing/unreadable file, non-JSON, wrong
+    top-level shape, ill-typed entry rows."""
+    from crosscoder_tpu.utils.compile_cache import DISK_FORMAT
+
+    tier = os.path.join(root, f"v{DISK_FORMAT}")
+    path = os.path.join(tier, "manifest.json")
+    if not os.path.isdir(tier):
+        raise ValueError(f"{root!r} holds no v{DISK_FORMAT} cache tier")
+    if not os.path.exists(path):
+        import glob
+
+        if glob.glob(os.path.join(tier, "*.exec")):
+            raise ValueError("cache holds executables but no manifest")
+        return {"version": DISK_FORMAT, "entries": {}}   # empty tier is fine
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
+    except OSError as e:
+        raise ValueError(f"manifest unreadable: {e}") from e
+    try:
+        m = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"manifest is not JSON: {e}") from e
+    if not isinstance(m, dict) or not isinstance(m.get("entries"), dict):
+        raise ValueError("manifest must be an object with an 'entries' map")
+    if m.get("version") != DISK_FORMAT:
+        raise ValueError(f"manifest version {m.get('version')!r} != "
+                         f"cache format {DISK_FORMAT}")
+    for digest, row in m["entries"].items():
+        if not isinstance(row, dict):
+            raise ValueError(f"entry {digest[:12]} is not an object")
+        for key, typ in (("bytes", (int, float)), ("variant", str),
+                         ("topology", str), ("created", (int, float)),
+                         ("last_used", (int, float))):
+            if not isinstance(row.get(key), typ):
+                raise ValueError(
+                    f"entry {digest[:12]} field {key!r} is "
+                    f"{type(row.get(key)).__name__}, want {typ}")
+    return m
+
+
+def _age(seconds: float) -> str:
+    if seconds < 90:
+        return f"{seconds:.0f}s"
+    if seconds < 90 * 60:
+        return f"{seconds / 60:.0f}m"
+    if seconds < 36 * 3600:
+        return f"{seconds / 3600:.1f}h"
+    return f"{seconds / 86400:.1f}d"
+
+
+def render(root: str, manifest: dict) -> str:
+    now = time.time()
+    entries = manifest["entries"]
+    lines = [f"compile cache: {root} (format v{manifest['version']}, "
+             f"{len(entries)} entr{'y' if len(entries) == 1 else 'ies'})"]
+    hdr = (f"{'digest':<14} {'variant':<34} {'topology':<22} "
+           f"{'bytes':>10} {'age':>6} {'last_used':>9}")
+    lines += ["", hdr, "-" * len(hdr)]
+    rows = sorted(entries.items(), key=lambda kv: -kv[1]["last_used"])
+    total = 0
+    for digest, row in rows:
+        total += int(row["bytes"])
+        lines.append(
+            f"{digest[:12]:<14} {row['variant'][:34]:<34} "
+            f"{row['topology'][:22]:<22} {int(row['bytes']):>10} "
+            f"{_age(now - row['created']):>6} "
+            f"{_age(now - row['last_used']):>9}")
+    lines += ["", f"total: {total} bytes across {len(rows)} executable(s)"]
+    # cross-check the advisory manifest against the actual files: rows
+    # whose bytes are gone (or files no row names) are worth surfacing
+    # even though the runtime tolerates both
+    import glob
+
+    on_disk = {os.path.basename(p)[:-len(".exec")]
+               for p in glob.glob(os.path.join(
+                   root, f"v{manifest['version']}", "*.exec"))}
+    missing = sorted(set(entries) - on_disk)
+    orphans = sorted(on_disk - set(entries))
+    if missing:
+        lines.append(f"note: {len(missing)} manifest row(s) have no .exec "
+                     f"file (evicted mid-update): "
+                     f"{', '.join(d[:12] for d in missing[:4])}")
+    if orphans:
+        lines.append(f"note: {len(orphans)} .exec file(s) missing from the "
+                     f"manifest (stored mid-crash): "
+                     f"{', '.join(d[:12] for d in orphans[:4])}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("cache_dir", help="cfg.compile_cache_dir of the runs "
+                                      "that populated the tier")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit the validated manifest as JSON instead "
+                         "of the table (for piping)")
+    args = ap.parse_args(argv)
+
+    try:
+        manifest = load_manifest(args.cache_dir)
+    except ValueError as e:
+        print(f"compile_cache_report: MALFORMED MANIFEST: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(manifest, indent=2, sort_keys=True, default=str))
+        return 0
+    print(render(args.cache_dir, manifest))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

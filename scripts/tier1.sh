@@ -47,4 +47,15 @@ env JAX_PLATFORMS=cpu python -m pytest \
 # top-K through the real Trainer, gate every candidate on the contracts
 # engine, and round-trip the pinned TUNED.json (docs/TUNING.md)
 env JAX_PLATFORMS=cpu python -m crosscoder_tpu.tune.smoke || exit 1
+# persistent-compile-cache warm-start smoke: one process populates the
+# disk tier (full serve warmup), a SECOND process must warm the whole
+# bucket ladder with zero XLA compiles (docs/SCALING.md "Persistent
+# compile cache"; --expect-zero-compiles exits nonzero otherwise)
+_CC_DIR=$(mktemp -d) || exit 1
+env JAX_PLATFORMS=cpu python -m crosscoder_tpu.serve.warm_start \
+    --cache-dir "$_CC_DIR" || { rm -rf "$_CC_DIR"; exit 1; }
+env JAX_PLATFORMS=cpu python -m crosscoder_tpu.serve.warm_start \
+    --cache-dir "$_CC_DIR" --expect-zero-compiles \
+    || { rm -rf "$_CC_DIR"; exit 1; }
+rm -rf "$_CC_DIR"
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

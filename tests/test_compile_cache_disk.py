@@ -1,0 +1,348 @@
+"""Tests for the persistent AOT executable tier
+(crosscoder_tpu/utils/compile_cache.py, docs/SCALING.md "Persistent
+compile cache"): hit/miss/eviction lifecycle, every fall-back gate
+(corrupt entry, fingerprint mismatch, strict verify), cross-process
+claim dedup with two REAL processes, warm-vs-cold bitwise training
+parity, zero-cost-off HLO identity, and the bounded thread-safe memo."""
+
+import json
+import pickle
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crosscoder_tpu.utils import compile_cache
+
+
+@pytest.fixture(autouse=True)
+def _reset_compile_cache():
+    """Snapshot + restore every module-global table so tests can flip
+    the disk tier and clear the memo without leaking into each other."""
+    with compile_cache._LOCK:
+        saved = (dict(compile_cache._AOT_CACHE),
+                 dict(compile_cache._COST_CACHE),
+                 dict(compile_cache._COST_PENDING),
+                 dict(compile_cache._COLLECTIVES),
+                 compile_cache._DISK, compile_cache._VERIFY)
+        compile_cache._AOT_CACHE.clear()
+        compile_cache._COST_CACHE.clear()
+        compile_cache._COST_PENDING.clear()
+        compile_cache._COLLECTIVES.clear()
+        compile_cache._DISK = None
+        compile_cache._VERIFY = "off"
+    yield
+    with compile_cache._LOCK:
+        compile_cache._AOT_CACHE.clear()
+        compile_cache._AOT_CACHE.update(saved[0])
+        compile_cache._COST_CACHE.clear()
+        compile_cache._COST_CACHE.update(saved[1])
+        compile_cache._COST_PENDING.clear()
+        compile_cache._COST_PENDING.update(saved[2])
+        compile_cache._COLLECTIVES.clear()
+        compile_cache._COLLECTIVES.update(saved[3])
+        compile_cache._DISK = saved[4]
+        compile_cache._VERIFY = saved[5]
+
+
+def _tiny_exe(i: int = 0):
+    """A real compiled executable (serializable) plus its lower()."""
+    x = jnp.arange(4.0)
+    lowered = jax.jit(lambda v: v * 2.0 + i).lower(x)
+    return lowered.compile(), lowered
+
+
+def _clear_memo():
+    with compile_cache._LOCK:
+        compile_cache._AOT_CACHE.clear()
+        compile_cache._COST_PENDING.clear()
+        compile_cache._COST_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: miss -> store -> hit -> evict
+
+
+def test_disk_roundtrip_and_cost_sidecar(tmp_path):
+    disk = compile_cache.configure(cache_dir=str(tmp_path / "cc"))
+    assert disk is not None and compile_cache.disk_enabled()
+    key = ("t_roundtrip", 4, "f32")
+    builds = []
+
+    def build():
+        exe, _ = _tiny_exe(1)
+        builds.append(1)
+        return exe
+
+    exe1 = compile_cache.aot_get(key, build)
+    assert builds == [1]
+    assert compile_cache.disk_entry_count() == 1
+    # second process simulated: cold memo, same disk
+    _clear_memo()
+    loads = []
+    exe2 = compile_cache.aot_get(key, build, on_load=loads.append)
+    assert builds == [1]                       # no recompile
+    assert loads == [key]
+    np.testing.assert_array_equal(np.asarray(exe2(jnp.arange(4.0))),
+                                  np.asarray(exe1(jnp.arange(4.0))))
+    stats = compile_cache.disk_stats()
+    assert stats["disk_hit"] == 1 and stats["disk_miss"] == 1
+    # the cost sidecar answers without any executable in the process
+    _clear_memo()
+    cost = compile_cache.cost_of(key)
+    assert cost is not None and set(cost) == {"flops", "bytes_accessed"}
+
+
+def test_eviction_respects_byte_cap(tmp_path):
+    exe, _ = _tiny_exe()
+    from jax.experimental.serialize_executable import serialize
+    one = len(pickle.dumps({"format": compile_cache.DISK_FORMAT,
+                            "payload": serialize(exe)[0]}))
+    disk = compile_cache.configure(cache_dir=str(tmp_path / "cc"),
+                                   max_bytes=int(2.5 * one))
+    digests = []
+    for i in range(4):
+        exe_i, low = _tiny_exe(i)
+        d = compile_cache.disk_key(("t_evict", i))
+        disk.store(d, exe_i, variant=f"v{i}", lower=lambda lw=low: lw)
+        digests.append(d)
+    total = sum(p.stat().st_size for p in disk.root.glob("*.exec"))
+    assert total <= int(2.5 * one)
+    assert not disk.has(digests[0])            # oldest went first
+    assert disk.has(digests[-1])               # newest survives
+    assert disk.stats["evictions"] >= 1
+    # manifest never names an evicted entry's bytes as live
+    m = disk.manifest()
+    assert digests[0] not in m["entries"]
+
+
+# ---------------------------------------------------------------------------
+# fall-back gates: the cache may be slower, never wrong or fatal
+
+
+def test_corrupt_entry_falls_back_to_live_build(tmp_path):
+    disk = compile_cache.configure(cache_dir=str(tmp_path / "cc"))
+    key = ("t_corrupt",)
+    compile_cache.aot_get(key, lambda: _tiny_exe(2)[0])
+    [path] = list(disk.root.glob("*.exec"))
+    path.write_bytes(b"\x00garbage" * 16)
+    _clear_memo()
+    builds = []
+    exe = compile_cache.aot_get(key, lambda: (builds.append(1),
+                                              _tiny_exe(2)[0])[1])
+    assert builds == [1]                       # rebuilt live, no crash
+    np.testing.assert_array_equal(np.asarray(exe(jnp.arange(4.0))),
+                                  np.arange(4.0) * 2.0 + 2)
+    # the rebuild re-stored a healthy entry: a third cold lookup loads
+    # from disk without building
+    _clear_memo()
+    compile_cache.aot_get(key, lambda: (builds.append(1),
+                                        _tiny_exe(2)[0])[1])
+    assert builds == [1]
+
+
+def test_fingerprint_mismatch_falls_back(tmp_path):
+    disk = compile_cache.configure(cache_dir=str(tmp_path / "cc"))
+    key = ("t_fpr",)
+    compile_cache.aot_get(key, lambda: _tiny_exe(3)[0])
+    [path] = list(disk.root.glob("*.exec"))
+    rec = pickle.loads(path.read_bytes())
+    rec["fingerprint"] = "jax=0.0.0,jaxlib=0.0.0,backend=other,device=x"
+    path.write_bytes(pickle.dumps(rec))
+    _clear_memo()
+    builds = []
+    compile_cache.aot_get(key, lambda: (builds.append(1),
+                                        _tiny_exe(3)[0])[1])
+    assert builds == [1]                       # stale entry never loads
+    assert compile_cache.disk_stats()["disk_miss"] >= 1
+
+
+def test_strict_verify_rejects_tampered_hlo(tmp_path):
+    disk = compile_cache.configure(cache_dir=str(tmp_path / "cc"),
+                                   verify="strict")
+    exe, low = _tiny_exe(4)
+    d = compile_cache.disk_key(("t_strict",))
+    disk.store(d, exe, lower=lambda: low)
+    [path] = list(disk.root.glob("*.exec"))
+    rec = pickle.loads(path.read_bytes())
+    rec["hlo_sha"] = "0" * 64                  # stored program lies
+    path.write_bytes(pickle.dumps(rec))
+    assert disk.load(d, lower=lambda: low, verify="strict") is None
+    assert not path.exists()                   # rejected AND discarded
+    # an honest entry passes strict verify
+    disk.store(d, exe, lower=lambda: low)
+    assert disk.load(d, lower=lambda: low, verify="strict") is not None
+
+
+# ---------------------------------------------------------------------------
+# cross-process claim dedup (two REAL processes)
+
+_RACE_SCRIPT = r"""
+import sys, time
+from crosscoder_tpu.utils import compile_cache
+
+compile_cache.configure(cache_dir=sys.argv[1])
+builds = []
+
+def build():
+    import jax, jax.numpy as jnp
+    time.sleep(1.0)        # widen the race window: both processes inside
+    builds.append(1)
+    return jax.jit(lambda v: v * 3.0).lower(jnp.arange(8.0)).compile()
+
+exe = compile_cache.aot_get(("race_key", 8), build)
+assert float(exe(__import__("jax.numpy", fromlist=["x"]).arange(8.0))[1]) == 3.0
+print(len(builds))
+"""
+
+
+def test_cross_process_claim_dedup(tmp_path):
+    """Two cold processes racing the same key: the claim-by-rename
+    leader builds ONCE; the loser blocks on the claim and deserializes
+    the winner's entry. Total builds across both processes == 1."""
+    cc = str(tmp_path / "cc")
+    script = tmp_path / "race.py"
+    script.write_text(_RACE_SCRIPT)
+    import os
+    import pathlib
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen([sys.executable, str(script), cc],
+                              stdout=subprocess.PIPE, text=True,
+                              cwd=repo_root, env=env)
+             for _ in range(2)]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs)
+    total_builds = sum(int(o.strip().splitlines()[-1]) for o in outs)
+    assert total_builds == 1, f"dedup failed: {total_builds} builds"
+    assert compile_cache.configure(cache_dir=cc) is not None
+    assert compile_cache.disk_entry_count() == 1
+
+
+# ---------------------------------------------------------------------------
+# warm-vs-cold training parity (the cache is invisible to numerics)
+
+
+def _run_losses(tmp_path, n=3):
+    from crosscoder_tpu.config import CrossCoderConfig
+    from crosscoder_tpu.train.trainer import Trainer
+
+    cfg = CrossCoderConfig(
+        d_in=16, dict_size=64, batch_size=32, num_tokens=32 * 200,
+        enc_dtype="fp32", lr=2e-3, l1_coeff=0.02, log_backend="null",
+        compile_cache_dir=str(tmp_path / "cc"))
+    tr = Trainer(cfg)
+    return [float(tr.step()["loss"]) for _ in range(n)]
+
+
+def test_warm_start_bitwise_equals_cold(tmp_path):
+    cold = _run_losses(tmp_path)
+    hits_before = compile_cache.disk_stats()["disk_hit"]
+    _clear_memo()                              # force the disk path
+    warm = _run_losses(tmp_path)
+    assert warm == cold                        # bitwise, not approx
+    assert compile_cache.disk_stats()["disk_hit"] > hits_before
+
+
+# ---------------------------------------------------------------------------
+# zero-cost off
+
+
+def test_knob_off_step_hlo_identity(tmp_path):
+    """With compile_cache_* set the step program lowers byte-identically
+    to the bare baseline — the knob is pure host-side plumbing."""
+    from crosscoder_tpu.analysis.contracts.hlo_rules import lower_step_text
+
+    base = lower_step_text(_step_cfg(), n_devices=1)
+    on = lower_step_text(
+        _step_cfg(compile_cache_dir=str(tmp_path / "cc"),
+                  compile_cache_max_bytes=1 << 20,
+                  compile_cache_verify="strict"), n_devices=1)
+    assert base == on
+
+
+def _step_cfg(**kw):
+    from crosscoder_tpu.config import CrossCoderConfig
+
+    base = dict(d_in=16, dict_size=64, batch_size=32,
+                enc_dtype="fp32", log_backend="null")
+    base.update(kw)
+    return CrossCoderConfig(**base)
+
+
+def test_disk_tier_off_by_default():
+    compile_cache.configure(_step_cfg())
+    assert not compile_cache.disk_enabled()
+    assert compile_cache.disk_entry_count() == 0
+    assert compile_cache.disk_stats() == {"disk_hit": 0, "disk_miss": 0,
+                                          "evictions": 0}
+
+
+# ---------------------------------------------------------------------------
+# the in-process memo: bounded, thread-safe, one build per key
+
+
+def test_aot_memo_hammer_one_build_per_key():
+    """8 threads hammering the same 32 keys (well under the cap):
+    concurrent misses coalesce onto ONE build each, every caller gets
+    the same executable object."""
+    n_keys, n_threads = 32, 8
+    builds = {k: 0 for k in range(n_keys)}
+    build_lock = threading.Lock()
+    barrier = threading.Barrier(n_threads)
+
+    def get(k):
+        def build():
+            with build_lock:
+                builds[k] += 1
+            return ("exe", k)
+        return compile_cache.aot_get(("hammer", k), build)
+
+    errors = []
+
+    def worker(seed):
+        try:
+            barrier.wait()
+            for j in range(n_keys):
+                k = (j + seed) % n_keys
+                exe = get(k)
+                assert exe == ("exe", k)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert all(builds[k] == 1 for k in range(n_keys)), builds
+
+
+def test_aot_memo_is_bounded_and_costs_survive_eviction(monkeypatch):
+    monkeypatch.setattr(compile_cache, "_AOT_CACHE_CAP", 8)
+    exe, _ = _tiny_exe()
+    for k in range(32):
+        compile_cache.aot_get(("bounded", k), lambda: exe)
+    assert len(compile_cache._AOT_CACHE) <= 8  # LRU stayed bounded
+    # a pending cost analysis settled before its executable was dropped
+    assert compile_cache.cost_of(("bounded", 0)) is not None
+
+
+def test_config_validation(tmp_path):
+    from crosscoder_tpu.config import CrossCoderConfig
+
+    with pytest.raises(ValueError, match="compile_cache_verify"):
+        _step_cfg(compile_cache_verify="strictest")
+    with pytest.raises(ValueError, match="compile_cache_max_bytes"):
+        _step_cfg(compile_cache_dir=str(tmp_path / "cc"),
+                  compile_cache_max_bytes=0)
+    cfg = _step_cfg(compile_cache_dir=str(tmp_path / "deep" / "cc"))
+    assert (tmp_path / "deep" / "cc").is_dir()  # dir-creatable check ran
+    assert cfg.compile_cache_verify == "off"
